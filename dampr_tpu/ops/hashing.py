@@ -1,0 +1,291 @@
+"""Vectorized 64-bit record hashing (dual uint32 lanes).
+
+Replaces the reference's per-record ``hash(key) % n_partitions`` partitioner
+(reference dampr/base.py:6-8 ``Splitter``) with a batched kernel: string keys become a
+padded uint8 matrix hashed by a dual-lane FNV-1a scan on device; integer keys go
+through a murmur-style finalizer.  Two independent 32-bit lanes (h1, h2) stand in for
+a 64-bit hash without requiring global ``jax_enable_x64``:
+
+- partition routing uses ``h1 % P`` (cheap, single lane);
+- grouping sorts lexicographically on ``(h1, h2)`` via ``lax.sort(num_keys=2)``;
+- host bookkeeping combines lanes into one uint64 (``combine64``).
+
+Collisions on the full 64 bits are detected by the HashRegistry in blocks.py (exact
+grouping falls back to comparing real keys), so hashing here only needs to be
+uniform, not perfect.
+
+Python-equality nuance: ``1 == 1.0 == True`` group together under the reference's
+sort+groupby semantics, so integral floats and bools are canonicalized to int64
+before hashing.
+"""
+
+import functools
+
+import numpy as np
+
+from .. import settings
+
+_FNV_OFFSET1 = np.uint32(2166136261)
+_FNV_OFFSET2 = np.uint32(0x9747B28C)
+_FNV_PRIME1 = np.uint32(16777619)
+_FNV_PRIME2 = np.uint32(0x85EBCA6B)
+
+# Length padding buckets bound jit recompilations for variable-width string blocks.
+_LEN_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _len_bucket(max_len):
+    for b in _LEN_BUCKETS:
+        if max_len <= b:
+            return b
+    # Very long keys: round up to a multiple of 1024.
+    return ((max_len + 1023) // 1024) * 1024
+
+
+def _pow2_rows(n):
+    p = 1 << max(0, (n - 1).bit_length())
+    return max(p, 8)
+
+
+def encode_str_keys(keys):
+    """Encode a sequence of str/bytes keys as (padded uint8 [N, L], lengths int32 [N]).
+
+    UTF-8 encodes str; bytes pass through.  L is bucketed to bound compilations.
+    """
+    bs = [k.encode("utf-8") if isinstance(k, str) else bytes(k) for k in keys]
+    n = len(bs)
+    max_len = max((len(b) for b in bs), default=1)
+    L = _len_bucket(max(max_len, 1))
+    mat = np.zeros((n, L), dtype=np.uint8)
+    lens = np.empty(n, dtype=np.int32)
+    for i, b in enumerate(bs):
+        lens[i] = len(b)
+        if b:
+            mat[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return mat, lens
+
+
+# ---------------------------------------------------------------------------
+# numpy host path
+# ---------------------------------------------------------------------------
+
+def _fnv_numpy(mat, lens):
+    n, L = mat.shape
+    h1 = np.full(n, _FNV_OFFSET1, dtype=np.uint32)
+    h2 = np.full(n, _FNV_OFFSET2, dtype=np.uint32)
+    cols = np.arange(L, dtype=np.int32)
+    with np.errstate(over="ignore"):
+        for c in range(L):
+            active = cols[c] < lens
+            b = mat[:, c].astype(np.uint32)
+            nh1 = (h1 ^ b) * _FNV_PRIME1
+            nh2 = (h2 ^ b) * _FNV_PRIME2
+            h1 = np.where(active, nh1, h1)
+            h2 = np.where(active, nh2, h2)
+    return h1, h2
+
+
+def _mix_int_numpy(vals_i64):
+    v = vals_i64.astype(np.uint64)
+    lo = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (v >> np.uint64(32)).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        h1 = _murmur_fmix_np(lo ^ np.uint32(0x9E3779B9), hi)
+        h2 = _murmur_fmix_np(lo ^ np.uint32(0x85EBCA6B), hi ^ np.uint32(0xC2B2AE35))
+    return h1, h2
+
+
+def _murmur_fmix_np(x, y):
+    h = x
+    h ^= y
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# JAX device path
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _fnv_jit():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def kernel(mat, lens):
+        n, L = mat.shape
+        h1 = jnp.full((n,), _FNV_OFFSET1, dtype=jnp.uint32)
+        h2 = jnp.full((n,), _FNV_OFFSET2, dtype=jnp.uint32)
+
+        def body(c, hs):
+            h1, h2 = hs
+            active = c < lens
+            b = mat[:, c].astype(jnp.uint32)
+            nh1 = (h1 ^ b) * _FNV_PRIME1
+            nh2 = (h2 ^ b) * _FNV_PRIME2
+            return (jnp.where(active, nh1, h1), jnp.where(active, nh2, h2))
+
+        h1, h2 = lax.fori_loop(0, L, body, (h1, h2))
+        return h1, h2
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _mix_int_jit():
+    import jax
+    import jax.numpy as jnp
+
+    def fmix(x, y):
+        h = x ^ y
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        return h
+
+    def kernel(lo, hi):
+        h1 = fmix(lo ^ jnp.uint32(0x9E3779B9), hi)
+        h2 = fmix(lo ^ jnp.uint32(0x85EBCA6B), hi ^ jnp.uint32(0xC2B2AE35))
+        return h1, h2
+
+    return jax.jit(kernel)
+
+
+def _use_device(n):
+    return settings.use_device and n >= settings.device_min_batch
+
+
+def _fnv(mat, lens):
+    n = mat.shape[0]
+    if not _use_device(n):
+        return _fnv_numpy(mat, lens)
+    np_rows = _pow2_rows(n)
+    if np_rows != n:
+        mat = np.pad(mat, ((0, np_rows - n), (0, 0)))
+        lens = np.pad(lens, (0, np_rows - n))
+    h1, h2 = _fnv_jit()(mat, lens)
+    return np.asarray(h1)[:n], np.asarray(h2)[:n]
+
+
+def _mix_int(vals_i64):
+    n = vals_i64.shape[0]
+    if not _use_device(n):
+        return _mix_int_numpy(vals_i64)
+    np_rows = _pow2_rows(n)
+    v = vals_i64
+    if np_rows != n:
+        v = np.pad(v, (0, np_rows - n))
+    u = v.astype(np.uint64)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    h1, h2 = _mix_int_jit()(lo, hi)
+    return np.asarray(h1)[:n], np.asarray(h2)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+def _canonical_int(k):
+    """Map bools / integral floats to int to mirror Python equality grouping."""
+    if isinstance(k, bool):
+        return int(k)
+    if isinstance(k, float) and k.is_integer():
+        return int(k)
+    return k
+
+
+def _host_hash_item(k):
+    """Deterministic per-item fallback hash for keys outside the fast paths
+    (tuples, frozensets, ...).  Uses Python's salted hash — stable within one
+    process, which is all partition routing + in-run grouping need."""
+    h = hash(k) & 0xFFFFFFFFFFFFFFFF
+    return np.uint32(h & 0xFFFFFFFF), np.uint32((h >> 32) ^ (h & 0xFFFFFFFF) ^ 0x51ED2701)
+
+
+def hash_keys(keys):
+    """Hash a batch of keys -> (h1, h2) uint32 arrays.
+
+    `keys` is a numpy array (numeric dtype or object) or a list.  Chooses the
+    vectorized int path, the byte-matrix FNV path, or the per-item host fallback.
+    """
+    if isinstance(keys, np.ndarray) and keys.dtype != object:
+        if np.issubdtype(keys.dtype, np.integer) or keys.dtype == np.bool_:
+            return _mix_int(keys.astype(np.int64))
+        if np.issubdtype(keys.dtype, np.floating):
+            return _hash_float_array(keys)
+        # other numeric dtypes: go through object path
+        keys = keys.astype(object)
+
+    keys = list(keys) if not isinstance(keys, np.ndarray) else keys
+    n = len(keys)
+    if n == 0:
+        return (np.empty(0, dtype=np.uint32), np.empty(0, dtype=np.uint32))
+
+    kinds = set()
+    for k in keys:
+        if isinstance(k, bool):
+            kinds.add(int)
+        elif isinstance(k, int):
+            kinds.add(int)
+        elif isinstance(k, float):
+            kinds.add(int if k.is_integer() else float)
+        elif isinstance(k, str):
+            kinds.add(str)
+        elif isinstance(k, bytes):
+            kinds.add(bytes)
+        else:
+            kinds.add(object)
+        if len(kinds) > 1:
+            break
+
+    if kinds == {int}:
+        arr = np.fromiter((int(_canonical_int(k)) for k in keys), dtype=np.int64,
+                          count=n)
+        return _mix_int(arr)
+    if kinds == {str} or kinds == {bytes}:
+        mat, lens = encode_str_keys(keys)
+        return _fnv(mat, lens)
+    if kinds == {float}:
+        arr = np.fromiter((float(k) for k in keys), dtype=np.float64, count=n)
+        return _hash_float_array(arr)
+
+    h1 = np.empty(n, dtype=np.uint32)
+    h2 = np.empty(n, dtype=np.uint32)
+    for i, k in enumerate(keys):
+        a, b = _host_hash_item(_freeze(k))
+        h1[i] = a
+        h2[i] = b
+    return h1, h2
+
+
+def _hash_float_array(arr):
+    """Float keys: integral values canonicalize to ints (Python equality);
+    the rest hash on their float64 bit pattern."""
+    arr64 = arr.astype(np.float64)
+    integral = (arr64 == np.floor(arr64)) & np.isfinite(arr64) & (np.abs(arr64) < 2 ** 62)
+    as_int = np.where(integral, arr64, 0).astype(np.int64)
+    bits = arr64.view(np.int64)
+    mixed_src = np.where(integral, as_int, bits)
+    return _mix_int(mixed_src)
+
+
+def _freeze(k):
+    if isinstance(k, list):
+        return tuple(_freeze(x) for x in k)
+    if isinstance(k, dict):
+        return tuple(sorted((kk, _freeze(vv)) for kk, vv in k.items()))
+    if isinstance(k, set):
+        return frozenset(k)
+    return k
+
+
+def combine64(h1, h2):
+    """Combine the two uint32 lanes into one uint64 per record (host only)."""
+    return (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(np.uint64)

@@ -1,0 +1,70 @@
+"""Global configuration for dampr_tpu.
+
+Parity surface: the reference exposes mutable module globals in dampr/settings.py:1-37
+(max_processes, compress_level, partitions, max_files_per_stage, batch_size,
+memory_checker_type, max_memory_per_worker).  We keep the same "assign a module
+attribute" ergonomics so reference users can switch without relearning config, and add
+TPU-specific knobs (mesh shape, device batch size, spill tiers) that have no reference
+analog.
+
+Unlike the reference, per-op overrides still ride graph-node ``options`` dicts
+(reference: runner.py:285/331, stagerunner.py:58-95), threaded through unchanged.
+"""
+
+import os
+
+import multiprocessing
+
+# ---------------------------------------------------------------------------
+# Parity knobs (same names/meaning as reference dampr/settings.py)
+# ---------------------------------------------------------------------------
+
+#: Max host-side worker threads for input IO / opaque-UDF map stages.  The
+#: reference forks this many processes (settings.py:5); we use threads because the
+#: heavy lifting happens on-device and numpy/IO release the GIL.
+max_processes = multiprocessing.cpu_count()
+
+#: gzip compression level for spilled blocks (reference settings.py:8).
+compress_level = 1
+
+#: Number of shuffle partitions (reference settings.py:11 uses 91).  We default to a
+#: multiple of typical mesh sizes so partitions map evenly onto devices.
+partitions = 64
+
+#: Upper bound on materialized block files per stage before a merge pass runs
+#: (reference settings.py:16 `max_files_per_stage`).
+max_files_per_stage = 50
+
+#: Records per host block flushed to the device path (reference settings.py:20 uses
+#: 1000 for pickle batches; device batches want to be much larger to amortize
+#: dispatch).
+batch_size = 65536
+
+#: Byte budget per stage for in-memory blocks before spilling to the next tier
+#: (replaces the reference's RSS-watermark `max_memory_per_worker`=512MB,
+#: settings.py:27 + memory.py — our block sizes are known, so accounting is
+#: deterministic, no /proc sampling).
+max_memory_per_stage = 512 * 1024 * 1024
+
+# ---------------------------------------------------------------------------
+# TPU-native knobs (no reference analog)
+# ---------------------------------------------------------------------------
+
+#: Mesh axis name used for data-parallel sharding of record batches.
+mesh_axis = "shards"
+
+#: When True, keyed kernels (hash/sort/segment-reduce) run through JAX on the default
+#: backend; when False everything uses the numpy host fallback (useful for debugging).
+use_device = os.environ.get("DAMPR_TPU_USE_DEVICE", "1") not in ("0", "false")
+
+#: Minimum records in a block before device dispatch is worth it; smaller blocks take
+#: the numpy path to dodge dispatch overhead.
+device_min_batch = 4096
+
+#: Capacity slack factor for the fixed-shape all_to_all shuffle exchange
+#: (MoE-style capacity: per-(src,dst) buffer = ceil(N/D) * factor).
+shuffle_capacity_factor = 1.5
+
+#: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
+#: base.py:435-469).
+scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")

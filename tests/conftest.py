@@ -1,0 +1,30 @@
+"""Test rig: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the 'multi-device without a real pod' fake backend from SURVEY.md §4:
+XLA_FLAGS=--xla_force_host_platform_device_count=8 + CPU platform, so sharding and
+collective paths are exercised on any machine.  Must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Small blocks should still exercise the device path in tests.
+os.environ.setdefault("DAMPR_TPU_USE_DEVICE", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = np.array(jax.devices())
+    assert devs.size == 8, devs
+    return Mesh(devs, ("shards",))

@@ -1,0 +1,160 @@
+"""Layer tests for blocks / hashing / segment kernels — coverage the reference
+lacks (SURVEY.md §4: 'add the layer-level tests Dampr lacks')."""
+
+import numpy as np
+import pytest
+
+from dampr_tpu import settings
+from dampr_tpu.blocks import Block, BlockBuilder
+from dampr_tpu.ops import hashing, segment
+
+
+def _mk_pairs(n, n_keys=7):
+    return [("key-%d" % (i % n_keys), i) for i in range(n)]
+
+
+class TestHashing(object):
+    def test_str_hash_deterministic(self):
+        keys = ["alpha", "beta", "gamma", "alpha", ""]
+        h1a, h2a = hashing.hash_keys(keys)
+        h1b, h2b = hashing.hash_keys(list(keys))
+        assert np.array_equal(h1a, h1b) and np.array_equal(h2a, h2b)
+        assert h1a[0] == h1a[3] and h2a[0] == h2a[3]
+        assert h1a[0] != h1a[1] or h2a[0] != h2a[1]
+
+    def test_device_matches_numpy(self):
+        keys = ["w%d" % (i % 997) for i in range(9000)]
+        old = settings.device_min_batch
+        try:
+            settings.device_min_batch = 1 << 30  # force numpy
+            h1n, h2n = hashing.hash_keys(keys)
+            settings.device_min_batch = 1  # force device
+            h1d, h2d = hashing.hash_keys(keys)
+        finally:
+            settings.device_min_batch = old
+        assert np.array_equal(h1n, h1d)
+        assert np.array_equal(h2n, h2d)
+
+    def test_int_float_bool_equivalence(self):
+        # Python equality semantics: 1 == 1.0 == True group together
+        h1, h2 = hashing.hash_keys([1, 1.0, True, 2])
+        assert h1[0] == h1[1] == h1[2]
+        assert h2[0] == h2[1] == h2[2]
+        assert (h1[3], h2[3]) != (h1[0], h2[0])
+
+    def test_int_array_path(self):
+        arr = np.arange(5000, dtype=np.int64)
+        h1, h2 = hashing.hash_keys(arr)
+        assert len(np.unique(hashing.combine64(h1, h2))) == 5000
+
+    def test_tuple_keys_fallback(self):
+        keys = [(1, "a"), (2, "b"), (1, "a")]
+        h1, h2 = hashing.hash_keys(keys)
+        assert h1[0] == h1[2] and h2[0] == h2[2]
+
+
+class TestBlock(object):
+    def test_from_pairs_numeric(self):
+        b = Block.from_pairs([("a", 1), ("b", 2), ("a", 3)])
+        assert b.numeric_values and not b.numeric_keys
+        assert list(b.iter_pairs()) == [("a", 1), ("b", 2), ("a", 3)]
+
+    def test_from_pairs_object_values(self):
+        b = Block.from_pairs([("a", [1, 2]), ("b", {"x": 1})])
+        assert not b.numeric_values
+        assert list(b.iter_pairs()) == [("a", [1, 2]), ("b", {"x": 1})]
+
+    def test_bigint_values_fall_back_to_object(self):
+        b = Block.from_pairs([("a", 2 ** 100), ("b", 1)])
+        assert not b.numeric_values
+        assert b.values[0] == 2 ** 100
+
+    def test_concat_mixed(self):
+        b1 = Block.from_pairs([("a", 1)])
+        b2 = Block.from_pairs([("b", [2])])
+        b = Block.concat([b1, b2])
+        assert len(b) == 2 and not b.numeric_values
+
+    def test_split_by_partition_routes_consistently(self):
+        b = Block.from_pairs(_mk_pairs(500))
+        parts = b.split_by_partition(8)
+        assert sum(len(p) for p in parts.values()) == 500
+        # same key always lands in the same partition
+        key_part = {}
+        for pid, pb in parts.items():
+            for k, _ in pb.iter_pairs():
+                assert key_part.setdefault(k, pid) == pid
+
+    def test_builder_batches(self):
+        bb = BlockBuilder(batch_size=100)
+        out = []
+        for k, v in _mk_pairs(250):
+            blk = bb.add(k, v)
+            if blk is not None:
+                out.append(blk)
+        tail = bb.flush()
+        if tail is not None:
+            out.append(tail)
+        assert sum(len(b) for b in out) == 250
+        assert len(out) == 3
+
+
+class TestSegment(object):
+    def test_sort_and_group_exact(self):
+        pairs = _mk_pairs(1000, n_keys=13)
+        g = segment.sort_and_group(Block.from_pairs(pairs))
+        got = dict(g.iter_groups())
+        want = {}
+        for k, v in pairs:
+            want.setdefault(k, []).append(v)
+        assert set(got) == set(want)
+        for k in want:
+            assert sorted(got[k]) == sorted(want[k])
+
+    @pytest.mark.parametrize("op,fn", [
+        (segment.SUM, sum), (segment.MIN, min), (segment.MAX, max)])
+    def test_fold_matches_python(self, op, fn):
+        pairs = _mk_pairs(5000, n_keys=37)
+        fb = segment.fold_block(Block.from_pairs(pairs), op)
+        got = dict(fb.iter_pairs())
+        want = {}
+        for k, v in pairs:
+            want.setdefault(k, []).append(v)
+        want = {k: fn(vs) for k, vs in want.items()}
+        assert got == want
+
+    def test_fold_device_matches_host(self):
+        pairs = _mk_pairs(8192, n_keys=201)
+        old = settings.device_min_batch
+        try:
+            settings.device_min_batch = 1
+            dev = dict(segment.fold_block(Block.from_pairs(pairs), segment.SUM).iter_pairs())
+            settings.device_min_batch = 1 << 30
+            host = dict(segment.fold_block(Block.from_pairs(pairs), segment.SUM).iter_pairs())
+        finally:
+            settings.device_min_batch = old
+        assert dev == host
+
+    def test_opaque_binop_fold(self):
+        pairs = [("k%d" % (i % 3), [i]) for i in range(30)]
+        fb = segment.fold_block(Block.from_pairs(pairs), segment.as_assoc_op(
+            lambda a, b: a + b))
+        got = dict(fb.iter_pairs())
+        for k, vs in got.items():
+            assert isinstance(vs, list) and len(vs) == 10
+
+    def test_hash_collision_repair(self):
+        # Force a collision by monkeypatching two distinct keys to equal hashes
+        b = Block.from_pairs([("aa", 1), ("bb", 2), ("aa", 3), ("bb", 4)])
+        h1, h2 = b.hashes()
+        b.h1 = np.zeros_like(h1)
+        b.h2 = np.zeros_like(h2)
+        g = segment.sort_and_group(b)
+        got = dict((k, sorted(v)) for k, v in g.iter_groups())
+        assert got == {"aa": [1, 3], "bb": [2, 4]}
+
+    def test_empty_block(self):
+        g = segment.sort_and_group(Block.empty())
+        assert list(g.iter_groups()) == []
+        fb = segment.fold_block(Block.empty(), segment.SUM)
+        assert len(fb) == 0
