@@ -36,7 +36,13 @@ def _column_from_list(xs):
     """Build the tightest column for a list of Python values."""
     n = len(xs)
     ts = set(map(type, xs))
-    if ts and ts <= set(_INT_TYPES):
+    if ts == {bool}:
+        # Preserve bool values exactly (True round-trips as True, not 1); the
+        # reference's pickled streams preserve bools and so do we.  Mixed
+        # bool/number columns drop to the object lane below for the same
+        # reason — casting would read True back as 1.
+        return np.fromiter(xs, dtype=np.bool_, count=n)
+    if ts == {int}:
         try:
             arr = np.empty(n, dtype=np.int64)
             for i, x in enumerate(xs):
@@ -46,8 +52,11 @@ def _column_from_list(xs):
             pass
     elif ts == {float}:
         return np.fromiter(xs, dtype=np.float64, count=n)
-    elif ts <= {float, int, bool} and ts:
-        return np.array([float(x) for x in xs], dtype=np.float64)
+    elif ts == {float, int}:
+        # Mixed int/float: float64 only when every int is exactly representable
+        # (|i| <= 2**53); otherwise the object lane preserves precision.
+        if all(isinstance(x, float) or abs(x) <= 2 ** 53 for x in xs):
+            return np.array([float(x) for x in xs], dtype=np.float64)
     out = np.empty(n, dtype=object)
     out[:] = xs
     return out

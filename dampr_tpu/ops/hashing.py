@@ -10,9 +10,9 @@ a 64-bit hash without requiring global ``jax_enable_x64``:
 - grouping sorts lexicographically on ``(h1, h2)`` via ``lax.sort(num_keys=2)``;
 - host bookkeeping combines lanes into one uint64 (``combine64``).
 
-Collisions on the full 64 bits are detected by the HashRegistry in blocks.py (exact
-grouping falls back to comparing real keys), so hashing here only needs to be
-uniform, not perfect.
+Collisions on the full 64 bits are detected during sort-based grouping
+(ops/segment.py ``sort_and_group`` compares real keys of same-hash neighbors and
+repairs boundaries), so hashing here only needs to be uniform, not perfect.
 
 Python-equality nuance: ``1 == 1.0 == True`` group together under the reference's
 sort+groupby semantics, so integral floats and bools are canonicalized to int64
@@ -201,75 +201,169 @@ def _canonical_int(k):
     return k
 
 
-def _host_hash_item(k):
-    """Deterministic per-item fallback hash for keys outside the fast paths
-    (tuples, frozensets, ...).  Uses Python's salted hash — stable within one
-    process, which is all partition routing + in-run grouping need."""
-    h = hash(k) & 0xFFFFFFFFFFFFFFFF
-    return np.uint32(h & 0xFFFFFFFF), np.uint32((h >> 32) ^ (h & 0xFFFFFFFF) ^ 0x51ED2701)
+# Per-item key kinds.  Each kind maps to exactly one typed hash kernel, so a key
+# hashes identically whether it appears in a homogeneous block or a mixed one
+# (dispatching on the whole batch's type-set would route 'x' differently in a
+# str-only block vs a str/int block — a shuffle-correctness bug).
+_K_INT = 0     # bool / int in int64 range / integral float in range -> _mix_int
+_K_STR = 1     # str / bytes -> dual-lane FNV over utf-8 bytes
+_K_FBITS = 2   # non-integral or huge float -> _mix_int over float64 bit pattern
+_K_OBJ = 3     # everything else -> deterministic canonical-bytes FNV
+
+_I64_LO = -(2 ** 63)
+_I64_HI = 2 ** 63 - 1
+
+
+def _kind_of(k):
+    if isinstance(k, np.generic):
+        # numpy scalars (np.int64, np.bool_, np.float32, ...) classify by their
+        # Python value — np.int64(5) must group with 5.
+        k = k.item()
+    if isinstance(k, bool):
+        return _K_INT
+    if isinstance(k, int):
+        if _I64_LO <= k <= _I64_HI:
+            return _K_INT
+        # Out-of-range int: if exactly float-representable, hash as float bits
+        # (Python equality: 10**300 == 1e300); else canonical-bytes lane.
+        try:
+            f = float(k)
+        except OverflowError:
+            return _K_OBJ
+        return _K_FBITS if int(f) == k else _K_OBJ
+    if isinstance(k, float):
+        # Strict upper bound: 2.0**63 is float-representable but overflows
+        # int64; anything strictly below converts exactly.
+        if k.is_integer() and -(2.0 ** 63) <= k < 2.0 ** 63:
+            return _K_INT
+        return _K_FBITS
+    if isinstance(k, (str, bytes)):
+        return _K_STR
+    return _K_OBJ
+
+
+def encode_canonical(k):
+    """Deterministic, type-tagged byte encoding of an arbitrary (hashable) key.
+
+    Used for the object-lane hash: equal keys encode equally across processes
+    and hosts (unlike Python's PYTHONHASHSEED-salted ``hash()``), so partition
+    routing of tuple/frozenset keys is stable across spill-reload and multi-host
+    boundaries.  Numeric leaves canonicalize exactly like the typed lanes
+    (1 == 1.0 == True encode identically)."""
+    if isinstance(k, np.generic):
+        k = k.item()
+    kind = _kind_of(k)
+    if kind == _K_INT:
+        return b"i" + str(int(_canonical_int(k))).encode("ascii")
+    if kind == _K_FBITS:
+        return b"f" + np.float64(k).tobytes()
+    if kind == _K_STR:
+        return (b"s" + k.encode("utf-8")) if isinstance(k, str) else (b"s" + bytes(k))
+    if isinstance(k, int):
+        # huge non-float-representable int
+        return b"I" + str(k).encode("ascii")
+    if k is None:
+        return b"N"
+    if isinstance(k, tuple):
+        return b"(" + _join_lenprefixed(encode_canonical(x) for x in k)
+    if isinstance(k, frozenset):
+        return b"{" + _join_lenprefixed(sorted(encode_canonical(x) for x in k))
+    # Last resort: repr (deterministic for well-behaved types).
+    return b"r" + repr(k).encode("utf-8", "backslashreplace")
+
+
+def _join_lenprefixed(encs):
+    """Length-prefix each element encoding so composites are injective —
+    ('a','b') and ('a\\x00sb',) must not encode identically."""
+    out = bytearray()
+    for e in encs:
+        out += len(e).to_bytes(4, "little")
+        out += e
+    return bytes(out)
+
+
+def _hash_object_items(items):
+    """Canonical-bytes FNV for a list of arbitrary keys -> (h1, h2)."""
+    encs = [encode_canonical(_freeze(k)) for k in items]
+    mat, lens = encode_str_keys(encs)
+    h1, h2 = _fnv(mat, lens)
+    # Tag the object lane so b"i5" (a str key) and int 5's encoding can't be
+    # confused with a real str key's hash by construction alone; collisions are
+    # still resolved exactly downstream, this just keeps them rare.
+    return h1 ^ np.uint32(0xA5A5A5A5), h2 ^ np.uint32(0x3C3C3C3C)
+
+
+def _hash_kind(kind, items):
+    """Run the single typed kernel for one homogeneous kind of keys.  Both the
+    homogeneous fast path and the mixed-kind scatter path go through here, so a
+    key's hash can never depend on which batch it arrived in."""
+    n = len(items)
+    if kind == _K_INT:
+        return _mix_int(np.fromiter(
+            (int(_canonical_int(k)) for k in items), dtype=np.int64, count=n))
+    if kind == _K_STR:
+        mat, lens = encode_str_keys(items)
+        return _fnv(mat, lens)
+    if kind == _K_FBITS:
+        return _mix_int(np.fromiter(
+            (float(k) for k in items), dtype=np.float64, count=n).view(np.int64))
+    return _hash_object_items(items)
 
 
 def hash_keys(keys):
     """Hash a batch of keys -> (h1, h2) uint32 arrays.
 
-    `keys` is a numpy array (numeric dtype or object) or a list.  Chooses the
-    vectorized int path, the byte-matrix FNV path, or the per-item host fallback.
+    `keys` is a numpy array (numeric dtype or object) or a list.  Dispatch is
+    per item kind, so mixed-type blocks hash each key with the same typed
+    kernel a homogeneous block would use (replaces the reference's per-record
+    ``hash(key)`` — dampr/base.py:6-8 — with batched kernels).
     """
     if isinstance(keys, np.ndarray) and keys.dtype != object:
         if np.issubdtype(keys.dtype, np.integer) or keys.dtype == np.bool_:
-            return _mix_int(keys.astype(np.int64))
-        if np.issubdtype(keys.dtype, np.floating):
+            if keys.dtype == np.uint64 and len(keys) and keys.max() > np.uint64(_I64_HI):
+                # astype(int64) would wrap; route through the per-item path so
+                # uint64 2**63+1 hashes like the equal Python int.
+                keys = keys.astype(object)
+            else:
+                return _mix_int(keys.astype(np.int64))
+        elif np.issubdtype(keys.dtype, np.floating):
             return _hash_float_array(keys)
-        # other numeric dtypes: go through object path
-        keys = keys.astype(object)
+        else:
+            # other dtypes (complex, datetime, ...): go through object path
+            keys = keys.astype(object)
 
     keys = list(keys) if not isinstance(keys, np.ndarray) else keys
     n = len(keys)
     if n == 0:
         return (np.empty(0, dtype=np.uint32), np.empty(0, dtype=np.uint32))
 
-    kinds = set()
-    for k in keys:
-        if isinstance(k, bool):
-            kinds.add(int)
-        elif isinstance(k, int):
-            kinds.add(int)
-        elif isinstance(k, float):
-            kinds.add(int if k.is_integer() else float)
-        elif isinstance(k, str):
-            kinds.add(str)
-        elif isinstance(k, bytes):
-            kinds.add(bytes)
-        else:
-            kinds.add(object)
-        if len(kinds) > 1:
-            break
+    kinds = np.empty(n, dtype=np.int8)
+    for i, k in enumerate(keys):
+        kinds[i] = _kind_of(k)
 
-    if kinds == {int}:
-        arr = np.fromiter((int(_canonical_int(k)) for k in keys), dtype=np.int64,
-                          count=n)
-        return _mix_int(arr)
-    if kinds == {str} or kinds == {bytes}:
-        mat, lens = encode_str_keys(keys)
-        return _fnv(mat, lens)
-    if kinds == {float}:
-        arr = np.fromiter((float(k) for k in keys), dtype=np.float64, count=n)
-        return _hash_float_array(arr)
+    uniq = set(kinds.tolist())
+    if len(uniq) == 1:
+        return _hash_kind(uniq.pop(), keys)
 
+    # Mixed kinds: hash each homogeneous sub-batch with its typed kernel and
+    # scatter results back into place.
     h1 = np.empty(n, dtype=np.uint32)
     h2 = np.empty(n, dtype=np.uint32)
-    for i, k in enumerate(keys):
-        a, b = _host_hash_item(_freeze(k))
-        h1[i] = a
-        h2[i] = b
+    for kind in uniq:
+        idx = np.flatnonzero(kinds == kind)
+        a, b = _hash_kind(kind, [keys[i] for i in idx])
+        h1[idx] = a
+        h2[idx] = b
     return h1, h2
 
 
 def _hash_float_array(arr):
-    """Float keys: integral values canonicalize to ints (Python equality);
-    the rest hash on their float64 bit pattern."""
+    """Float keys: integral in-int64-range values canonicalize to ints (Python
+    equality: 1.0 groups with 1); the rest hash on their float64 bit pattern.
+    Bounds match ``_kind_of`` exactly so container type never changes a hash."""
     arr64 = arr.astype(np.float64)
-    integral = (arr64 == np.floor(arr64)) & np.isfinite(arr64) & (np.abs(arr64) < 2 ** 62)
+    integral = ((arr64 == np.floor(arr64)) & np.isfinite(arr64)
+                & (arr64 >= -(2.0 ** 63)) & (arr64 < 2.0 ** 63))
     as_int = np.where(integral, arr64, 0).astype(np.int64)
     bits = arr64.view(np.int64)
     mixed_src = np.where(integral, as_int, bits)

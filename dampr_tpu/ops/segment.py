@@ -284,8 +284,16 @@ def fold_sorted(groups, op):
 
     if op.kind in _NP_FOLD and sb.numeric_values:
         vals = sb.values
+        if vals.dtype == np.bool_:
+            # Python semantics: True + True == 2; promote before folding
+            # (min/max could stay bool, but a uniform int64 lane is simpler and
+            # round-trips bools as 0/1 exactly like the reference's binop).
+            vals = vals.astype(np.int64)
         if settings.use_device and n >= settings.device_min_batch:
-            seg_ids = np.cumsum(_adjacent_new_segment(sb.h1, sb.h2)) - 1
+            # Segment ids must come from the collision-repaired group bounds,
+            # not raw (h1,h2) adjacency — after a 64-bit collision the repaired
+            # starts split a hash-run into multiple real-key groups.
+            seg_ids = np.repeat(np.arange(ng, dtype=np.int64), ends - starts)
             npad = _pow2(n)
             ng_pad = _pow2(ng)
             if npad != n:
@@ -302,7 +310,7 @@ def fold_sorted(groups, op):
             # identity pad values, which is still correct.
         else:
             ufunc = _NP_FOLD[op.kind]
-            folded = ufunc.reduceat(sb.values, starts)
+            folded = ufunc.reduceat(vals, starts)
         return Block(keys, folded, kh1, kh2)
 
     # host generic fold
