@@ -181,19 +181,35 @@ def _concat_cols(cols):
     dtypes = {c.dtype for c in cols}
     if len(dtypes) == 1 and object not in dtypes:
         return np.concatenate(cols)
-    if object in dtypes or len(dtypes) > 1:
-        non_obj = [c for c in cols if c.dtype != object]
-        if len(non_obj) == len(cols):
-            # mixed numeric dtypes: promote
-            return np.concatenate([c.astype(np.result_type(*dtypes)) for c in cols])
-        total = sum(len(c) for c in cols)
-        out = np.empty(total, dtype=object)
-        at = 0
-        for c in cols:
+    if object not in dtypes:
+        # Mixed numeric dtypes.  Promotion must obey the same value-preserving
+        # rules as _column_from_list: bools never silently become numbers, and
+        # int64 joins float64 only when every int is float-exact.
+        if any(dt == np.bool_ for dt in dtypes):
+            return _as_object_concat(cols)
+        target = np.result_type(*dtypes)
+        if target.kind == "f":
+            for c in cols:
+                if c.dtype.kind in "iu" and len(c) and (
+                        np.abs(c).max() > 2 ** 53):
+                    return _as_object_concat(cols)
+        return np.concatenate([c.astype(target) for c in cols])
+    return _as_object_concat(cols)
+
+
+def _as_object_concat(cols):
+    total = sum(len(c) for c in cols)
+    out = np.empty(total, dtype=object)
+    at = 0
+    for c in cols:
+        if c.dtype == object:
             out[at: at + len(c)] = c
-            at += len(c)
-        return out
-    return np.concatenate(cols)
+        else:
+            # .item()-ize so downstream sees Python scalars, matching
+            # iter_pairs semantics for values that started in object lanes.
+            out[at: at + len(c)] = [x.item() for x in c]
+        at += len(c)
+    return out
 
 
 class BlockBuilder(object):

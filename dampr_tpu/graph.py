@@ -157,11 +157,5 @@ class Graph(object):
                 stages.append(node)
         return Graph(stages)
 
-    def node_for(self, source):
-        for node in self.stages:
-            if node.output == source:
-                return node
-        return None
-
     def __repr__(self):
         return "Graph[{} stages]".format(len(self.stages))

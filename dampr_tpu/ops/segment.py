@@ -45,11 +45,30 @@ class AssocOp(object):
 SUM = AssocOp("sum", lambda a, b: a + b)
 MIN = AssocOp("min", lambda a, b: a if a <= b else b)
 MAX = AssocOp("max", lambda a, b: a if a >= b else b)
+FIRST = AssocOp("first", lambda a, _b: a)
+
+
+def _builtin_ops():
+    import operator
+    return {operator.add: SUM, operator.iadd: SUM,
+            min: MIN, max: MAX}
+
+
+_BUILTIN_OPS = None
 
 
 def as_assoc_op(binop):
+    """Wrap a Python binop; recognized builtins (operator.add, min, max) get a
+    device-foldable kind so ``count()``/``a_group_by(...).reduce(operator.add)``
+    hit segment kernels, not per-record Python."""
+    global _BUILTIN_OPS
     if isinstance(binop, AssocOp):
         return binop
+    if _BUILTIN_OPS is None:
+        _BUILTIN_OPS = _builtin_ops()
+    hit = _BUILTIN_OPS.get(binop)
+    if hit is not None:
+        return hit
     return AssocOp(None, binop)
 
 
@@ -281,6 +300,11 @@ def fold_sorted(groups, op):
     kh1 = sb.h1.take(starts)
     kh2 = sb.h2.take(starts)
     keys = sb.keys.take(starts)
+
+    if op.kind == "first":
+        # Stable sort preserves arrival order within groups, so the group's
+        # first record is at its start offset — a pure gather, any dtype.
+        return Block(keys, sb.values.take(starts), kh1, kh2)
 
     if op.kind in _NP_FOLD and sb.numeric_values:
         vals = sb.values

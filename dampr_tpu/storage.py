@@ -1,0 +1,195 @@
+"""Out-of-core storage: block refs, spill tiers, scratch layout, accounting.
+
+Replaces the reference's disk-spill machinery — RSS-watermark writers
+(dampr/dataset.py:119-262, memory.py) and the /tmp/<job>/stage_N scratch tree
+(base.py:435-469) — with deterministic byte accounting: block sizes are known
+exactly, so no /proc sampling is needed.  The tier order is RAM → disk
+(HBM-resident arrays are transient inside kernels; host RAM is the working
+tier, gzip'd pickle files the spill tier).
+
+Every stage output lives behind :class:`BlockRef`; the per-run
+:class:`RunStore` decides which refs stay hot.  ``pin=True`` refs (``cached()``
+stages) never spill.
+"""
+
+import gzip
+import logging
+import os
+import pickle
+import shutil
+import threading
+import uuid
+
+from . import settings
+
+log = logging.getLogger("dampr_tpu.storage")
+
+
+class BlockRef(object):
+    """A handle to one materialized block: RAM-resident or spilled to disk."""
+
+    __slots__ = ("_block", "path", "nbytes", "nrecords", "store", "pin")
+
+    def __init__(self, block, store=None, pin=False):
+        self._block = block
+        self.path = None
+        self.nbytes = block.nbytes()
+        self.nrecords = len(block)
+        self.store = store
+        self.pin = pin
+
+    def __len__(self):
+        return self.nrecords
+
+    @property
+    def resident(self):
+        return self._block is not None
+
+    def get(self):
+        blk = self._block
+        if blk is None:
+            blk = load_block(self.path)
+            # Do not re-cache: reduce jobs stream partitions one at a time and
+            # re-residency would defeat the memory bound.
+        return blk
+
+    def spill(self, directory):
+        if self._block is None or self.pin:
+            return 0
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, uuid.uuid4().hex + ".blk")
+        save_block(self._block, self.path)
+        freed = self.nbytes
+        self._block = None
+        return freed
+
+    def delete(self):
+        self._block = None
+        if self.path and os.path.exists(self.path):
+            os.unlink(self.path)
+            self.path = None
+
+
+def save_block(block, path):
+    """Spill wire format: pickle of the columnar arrays inside a gzip stream.
+    Numeric lanes serialize as raw buffers (pickle protocol 5); object lanes
+    pickle per element — same tradeoff as the reference's gzip+pickle batches
+    (dataset.py:20-41) but columnar."""
+    with gzip.open(path, "wb", compresslevel=settings.compress_level) as f:
+        pickle.dump((block.keys, block.values, block.h1, block.h2), f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_block(path):
+    from .blocks import Block
+
+    with gzip.open(path, "rb") as f:
+        keys, values, h1, h2 = pickle.load(f)
+    return Block(keys, values, h1, h2)
+
+
+class RunStore(object):
+    """Per-run block registry with a byte budget (the memory-governor analog).
+
+    Tracks every RAM-resident ref; when residency exceeds
+    ``settings.max_memory_per_stage`` the oldest unpinned refs spill to the
+    run's scratch directory.  Thread-safe — map jobs register refs
+    concurrently.
+    """
+
+    def __init__(self, name, budget=None):
+        safe = name.replace("/", "_")
+        self.root = os.path.join(settings.scratch_root, safe)
+        self.budget = settings.max_memory_per_stage if budget is None else budget
+        self._lock = threading.Lock()
+        self._resident = []          # FIFO of RAM refs
+        self._resident_bytes = 0
+        self._stage = "stage_0"
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    def set_stage(self, stage_name):
+        self._stage = "stage_{}".format(stage_name)
+
+    def register(self, block, pin=False):
+        ref = BlockRef(block, store=self, pin=pin)
+        with self._lock:
+            self._resident.append(ref)
+            self._resident_bytes += ref.nbytes
+            self._maybe_spill_locked()
+        return ref
+
+    def _maybe_spill_locked(self):
+        if self._resident_bytes <= self.budget:
+            return
+        directory = os.path.join(self.root, self._stage)
+        keep = []
+        for ref in self._resident:
+            if self._resident_bytes <= self.budget:
+                keep.append(ref)
+                continue
+            if ref.pin or not ref.resident:
+                if ref.resident:
+                    keep.append(ref)
+                continue
+            freed = ref.spill(directory)
+            if freed:
+                self.spill_count += 1
+                self.spilled_bytes += freed
+                self._resident_bytes -= freed
+            else:
+                keep.append(ref)
+        self._resident = [r for r in keep if r.resident]
+        if self._resident_bytes > self.budget:
+            log.warning(
+                "RunStore over budget even after spilling (%d > %d bytes) — "
+                "pinned blocks exceed the memory budget",
+                self._resident_bytes, self.budget)
+
+    def drop_ref(self, ref):
+        with self._lock:
+            if ref in self._resident:
+                self._resident.remove(ref)
+                self._resident_bytes -= ref.nbytes
+        ref.delete()
+
+    def cleanup(self):
+        """Remove the run's scratch tree (outputs the caller wants to keep
+        must have been read or re-registered elsewhere first)."""
+        if os.path.isdir(self.root):
+            shutil.rmtree(self.root, ignore_errors=True)
+
+
+class PartitionSet(object):
+    """The stage-exchange format: {partition_id: [BlockRef]} — the engine
+    analog of the reference's {partition: [Dataset]} dicts
+    (base.py:416-433, runner.py:163-172)."""
+
+    __slots__ = ("parts", "n_partitions")
+
+    def __init__(self, n_partitions):
+        self.parts = {}
+        self.n_partitions = n_partitions
+
+    def add(self, pid, ref):
+        self.parts.setdefault(pid, []).append(ref)
+
+    def refs(self, pid):
+        return self.parts.get(pid, [])
+
+    def all_refs(self):
+        for pid in sorted(self.parts):
+            for ref in self.parts[pid]:
+                yield ref
+
+    def total_records(self):
+        return sum(len(r) for r in self.all_refs())
+
+    def delete(self, store=None):
+        for refs in self.parts.values():
+            for ref in refs:
+                if store is not None:
+                    store.drop_ref(ref)
+                else:
+                    ref.delete()
+        self.parts = {}

@@ -1,0 +1,30 @@
+"""Word count — the canonical end-to-end slice (reference examples/wc.py).
+
+Tokenization streams on host threads; the keyed count compiles to vectorized
+hash + device segment-sum with map-side combining before the shuffle.
+
+Usage: python examples/wc.py <file-or-dir> [chunk_size_mb]
+"""
+
+import sys
+
+from dampr_tpu import Dampr, setup_logging
+
+
+def main(path, chunk_mb=16):
+    wc = (Dampr.text(path, chunk_size=chunk_mb * 1024 ** 2)
+          .flat_map(lambda line: line.split())
+          .fold_by(lambda w: w, binop=lambda x, y: x + y, value=lambda w: 1))
+
+    results = wc.run("word-count")
+    for word, count in sorted(results, key=lambda wc: wc[1], reverse=True)[:20]:
+        print("{}: {}".format(word, count))
+    results.delete()
+
+
+if __name__ == "__main__":
+    setup_logging()
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 16)

@@ -1,0 +1,55 @@
+"""Multi-output word statistics over a shared pipeline root (reference
+examples/word-stats.py): four graphs sharing one tokenize+count prefix are
+unioned into a single run, so the shared stages compute once.
+
+Usage: python examples/word_stats.py <file-or-dir>
+"""
+
+import sys
+
+from dampr_tpu import Dampr, setup_logging
+
+
+def main(fname):
+    # Shared root: tokenized words, counted once.
+    words = Dampr.text(fname, 1024 ** 2).flat_map(lambda line: line.split())
+
+    top_words = (words.count(lambda x: x)
+                 .sort_by(lambda word_count: -word_count[1]))
+
+    total_count = top_words.fold_by(
+        key=lambda word: 1,
+        value=lambda x: x[1],
+        binop=lambda x, y: x + y)
+
+    word_lengths = (top_words
+                    .fold_by(lambda tc: len(tc[0]),
+                             value=lambda tc: tc[1],
+                             binop=lambda x, y: x + y)
+                    .sort_by(lambda cl: cl[0]))
+
+    avg_word_lengths = (word_lengths
+                        .map(lambda wl: wl[0] * wl[1])
+                        .a_group_by(lambda x: 1)
+                        .sum()
+                        .join(total_count)
+                        .reduce(lambda awl, tc:
+                                next(awl)[1] / float(next(tc)[1])))
+
+    tc, tw, wl, awl = Dampr.run(total_count, top_words, word_lengths,
+                                avg_word_lengths, name="word-stats")
+
+    print("\nWord Stats\n" + "*" * 10)
+    print("Total Words Found:", tc.read(1)[0][1])
+    print("\nTop 10 words")
+    for word, count in tw.read(10):
+        print(word, count)
+    print("\nCharacter histogram")
+    for cl, length in wl.read(20):
+        print(cl, length)
+    print("\nAverage Word Length:", awl.read(1)[0][1])
+
+
+if __name__ == "__main__":
+    setup_logging()
+    main(sys.argv[1])
