@@ -283,6 +283,38 @@ _NP_FOLD = {
     "max": np.maximum,
 }
 
+_I32_MAX = 2 ** 31 - 1
+
+
+def _device_fold_exact(vals, kind):
+    """True when folding ``vals`` in the device's 32-bit lanes is exact
+    (jax_enable_x64 is off, so int64/float64 inputs would silently truncate
+    to int32/float32 on device — the host numpy path stays exact instead).
+
+    - int64: every *result* must fit int32; for 'sum' bound by sum(|v|)
+      (conservative: any per-group sum is within it), for min/max by max(|v|).
+    - float64: device would drop to float32 precision; keep on host unless
+      values already are float32.
+    """
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return True
+    if vals.dtype == np.int64:
+        if not len(vals):
+            return True
+        lo, hi = int(vals.min()), int(vals.max())
+        if lo < -_I32_MAX - 1 or hi > _I32_MAX:
+            return False  # (min/max never overflow; np.abs would wrap at int64 min)
+        if kind == "sum":
+            # |v| <= 2**31 each, so the int64 abs-sum is exact for any
+            # realistic block length; it bounds every per-group sum.
+            return int(np.abs(vals).sum()) <= _I32_MAX
+        return True
+    if vals.dtype == np.float64:
+        return False
+    return True
+
 
 def fold_sorted(groups, op):
     """Fold each group's values with ``op`` -> compacted Block (one record per
@@ -313,10 +345,17 @@ def fold_sorted(groups, op):
             # (min/max could stay bool, but a uniform int64 lane is simpler and
             # round-trips bools as 0/1 exactly like the reference's binop).
             vals = vals.astype(np.int64)
-        if settings.use_device and n >= settings.device_min_batch:
+        if (settings.use_device and n >= settings.device_min_batch
+                and _device_fold_exact(vals, op.kind)):
             # Segment ids must come from the collision-repaired group bounds,
             # not raw (h1,h2) adjacency — after a 64-bit collision the repaired
             # starts split a hash-run into multiple real-key groups.
+            import jax as _jax
+            if not _jax.config.jax_enable_x64:
+                # Explicit lossless cast into the 32-bit device lanes
+                # (_device_fold_exact guaranteed representability).
+                if vals.dtype == np.int64:
+                    vals = vals.astype(np.int32)
             seg_ids = np.repeat(np.arange(ng, dtype=np.int64), ends - starts)
             npad = _pow2(n)
             ng_pad = _pow2(ng)

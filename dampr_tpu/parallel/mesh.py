@@ -1,0 +1,33 @@
+"""Mesh construction helpers.
+
+One logical axis (``settings.mesh_axis``) carries data-parallel record shards;
+the same axis carries the all_to_all shuffle.  Multi-host topologies reuse the
+identical program: jax enumerates global devices and XLA routes ICI within a
+host/slice and DCN across, so nothing here is host-count-aware.
+"""
+
+import numpy as np
+
+from .. import settings
+
+
+def data_mesh(devices=None, n=None):
+    """A 1-D mesh over ``devices`` (default: all) named by settings.mesh_axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n is not None:
+        assert n <= len(devices), (
+            "requested {} devices, have {}".format(n, len(devices)))
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (settings.mesh_axis,))
+
+
+def default_mesh():
+    return data_mesh()
+
+
+def mesh_size(mesh):
+    return int(np.prod(list(mesh.shape.values())))

@@ -116,35 +116,42 @@ class RunStore(object):
         with self._lock:
             self._resident.append(ref)
             self._resident_bytes += ref.nbytes
-            self._maybe_spill_locked()
+            victims = self._select_victims_locked()
+        # Spill I/O happens OUTSIDE the lock: victims are already removed from
+        # the resident list (each ref is selected exactly once), so concurrent
+        # workers keep registering while gzip+write proceeds here.
+        if victims:
+            directory = os.path.join(self.root, self._stage)
+            freed = 0
+            for v in victims:
+                freed += v.spill(directory)
+            with self._lock:
+                self.spill_count += len(victims)
+                self.spilled_bytes += freed
         return ref
 
-    def _maybe_spill_locked(self):
+    def _select_victims_locked(self):
+        """Pick oldest unpinned refs until projected residency meets the
+        budget; deduct their bytes immediately so other threads see the
+        budget as already relieved."""
         if self._resident_bytes <= self.budget:
-            return
-        directory = os.path.join(self.root, self._stage)
+            return []
+        victims = []
         keep = []
         for ref in self._resident:
-            if self._resident_bytes <= self.budget:
-                keep.append(ref)
-                continue
-            if ref.pin or not ref.resident:
-                if ref.resident:
-                    keep.append(ref)
-                continue
-            freed = ref.spill(directory)
-            if freed:
-                self.spill_count += 1
-                self.spilled_bytes += freed
-                self._resident_bytes -= freed
+            if (self._resident_bytes > self.budget and not ref.pin
+                    and ref.resident):
+                victims.append(ref)
+                self._resident_bytes -= ref.nbytes
             else:
                 keep.append(ref)
-        self._resident = [r for r in keep if r.resident]
+        self._resident = keep
         if self._resident_bytes > self.budget:
             log.warning(
                 "RunStore over budget even after spilling (%d > %d bytes) — "
                 "pinned blocks exceed the memory budget",
                 self._resident_bytes, self.budget)
+        return victims
 
     def drop_ref(self, ref):
         with self._lock:

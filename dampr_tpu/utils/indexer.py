@@ -74,11 +74,13 @@ class Indexer(object):
                 .fold_by(key=lambda _x: 1, binop=lambda x, y: x + y)
                 .read(name="indexing"))
 
-    def _seek_lines(self, query):
+    def _seek_lines(self, query, params):
+        params = tuple(params)
+
         def read_db(fname):
             db = self._open_db(fname)
             cur = db.cursor()
-            cur.execute(query)
+            cur.execute(query, params)
             with open(fname, "rb") as f:
                 for (offset,) in cur:
                     f.seek(offset)
@@ -94,8 +96,8 @@ class Indexer(object):
             keys = [keys]
         query = ("select distinct offset from key_index where key in ({}) "
                  "order by offset asc").format(
-                     ",".join('"{}"'.format(k) for k in keys))
-        return self._seek_lines(query)
+                     ",".join("?" for _ in keys))
+        return self._seek_lines(query, keys)
 
     def intersect(self, keys, min_match=None):
         """Lines containing at least ``min_match`` of the keys (all, by
@@ -107,7 +109,7 @@ class Indexer(object):
         if isinstance(min_match, float):
             min_match = int(min_match * len(keys))
         query = ("select offset from (select offset, count(*) as c from "
-                 "key_index where key in ({}) group by offset) where c >= {} "
+                 "key_index where key in ({}) group by offset) where c >= ? "
                  "order by offset asc").format(
-                     ",".join('"{}"'.format(k) for k in keys), min_match)
-        return self._seek_lines(query)
+                     ",".join("?" for _ in keys))
+        return self._seek_lines(query, list(keys) + [min_match])

@@ -1,17 +1,25 @@
-"""Test rig: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test rig: an 8-device virtual CPU mesh (SURVEY §4's 'multi-device without
+a real pod' fake backend).
 
-This is the 'multi-device without a real pod' fake backend from SURVEY.md §4:
-XLA_FLAGS=--xla_force_host_platform_device_count=8 + CPU platform, so sharding and
-collective paths are exercised on any machine.  Must run before any jax import.
+Two things must happen before JAX initializes a backend:
+- XLA_FLAGS gains --xla_force_host_platform_device_count=8 (env, read at
+  backend init);
+- platform selection must be forced to cpu *via jax.config*, because the
+  environment's TPU plugin (axon) programmatically sets
+  jax_platforms="axon,cpu" at interpreter start, clobbering any JAX_PLATFORMS
+  env var — an env-var setdefault silently loses.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Small blocks should still exercise the device path in tests.
 os.environ.setdefault("DAMPR_TPU_USE_DEVICE", "1")
@@ -21,9 +29,8 @@ import pytest  # noqa: E402
 
 @pytest.fixture(scope="session")
 def mesh8():
-    import jax
-    from jax.sharding import Mesh
     import numpy as np
+    from jax.sharding import Mesh
 
     devs = np.array(jax.devices())
     assert devs.size == 8, devs

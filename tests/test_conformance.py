@@ -257,21 +257,31 @@ class TestCustomOperators:
         assert sum(out) == sum(range(10, 20))
 
     def test_block_reducer(self, items):
-        class CountGroups(BlockReducer):
+        class SumGroups(BlockReducer):
             def start(self):
-                self.n = 0
+                self.total = 0
 
             def add(self, k, it):
-                self.n += 1
+                self.total += sum(it)
                 return ()
 
             def finish(self):
-                yield "groups", self.n
+                if self.total:
+                    yield "total", self.total
 
-        out = (items.group_by(lambda x: x % 3)
-               .partition_reduce(lambda groups: (
-                   ("groups", 1) for _ in groups)).read())
-        assert sum(v for _k, v in out) == 3
+        # custom_reducer with a stateful BlockReducer: start/add/finish run
+        # per partition; partials sum to the global total.
+        out = items.custom_reducer(SumGroups()).read()
+        assert sum(out) == sum(range(10, 20))
+
+    def test_stream_reducer_runs_on_empty_partition(self):
+        def observe(groups):
+            yield "ran", sum(1 for _ in groups)
+
+        out = Dampr.memory([1]).partition_reduce(observe).read()
+        # one record -> one non-empty partition; empty partitions still ran
+        assert len(out) == 8  # = settings.partitions in this fixture
+        assert sum(v[1] for v in out) == 1
 
     def test_stream_mapper_runs_on_empty(self):
         ran = []

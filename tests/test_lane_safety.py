@@ -1,0 +1,81 @@
+"""32-bit device-lane safety: large int64/float64 folds must be exact (host
+fallback) or refuse loudly — never silently truncate."""
+
+import numpy as np
+import pytest
+
+from dampr_tpu.blocks import Block
+from dampr_tpu.ops import segment
+from dampr_tpu.parallel import mesh_global_sum, mesh_keyed_fold
+from dampr_tpu.ops import hashing
+
+
+class TestSegmentFoldLanes:
+    def test_large_int64_sum_exact(self):
+        n = 5000  # >= device_min_batch, would truncate on 32-bit lanes
+        blk = Block.from_pairs([("k", 2 ** 40)] * n)
+        out = dict(segment.fold_block(blk, segment.SUM).iter_pairs())
+        assert out == {"k": n * 2 ** 40}
+
+    def test_int32_range_sum_overflow_guarded(self):
+        n = 5000
+        blk = Block.from_pairs([("k", 10 ** 6)] * n)
+        out = dict(segment.fold_block(blk, segment.SUM).iter_pairs())
+        assert out == {"k": n * 10 ** 6}  # 5e9 > int32 max
+
+    def test_float64_sum_keeps_precision(self):
+        n = 5000
+        blk = Block.from_pairs([("k", 1.0 + 1e-12)] * n)
+        out = dict(segment.fold_block(blk, segment.SUM).iter_pairs())
+        assert abs(out["k"] - n * (1.0 + 1e-12)) < 1e-6
+
+    def test_small_ints_still_use_device(self):
+        n = 5000
+        blk = Block.from_pairs([("a", 1)] * n + [("b", 2)] * n)
+        out = dict(segment.fold_block(blk, segment.SUM).iter_pairs())
+        assert out == {"a": n, "b": 2 * n}
+
+    def test_min_max_large_values(self):
+        n = 5000
+        vals = [2 ** 40 + i for i in range(n)]
+        blk = Block.from_pairs([("k", v) for v in vals])
+        assert dict(segment.fold_block(blk, segment.MIN).iter_pairs()) == {
+            "k": 2 ** 40}
+        assert dict(segment.fold_block(blk, segment.MAX).iter_pairs()) == {
+            "k": 2 ** 40 + n - 1}
+
+
+class TestMeshLanes:
+    def test_keyed_fold_large_int_raises(self, mesh8):
+        h1, h2 = hashing.hash_keys(np.array([1] * 10))
+        with pytest.raises(ValueError, match="32-bit"):
+            mesh_keyed_fold(mesh8, h1, h2,
+                            np.full(10, 10 ** 9, dtype=np.int64), "sum")
+
+    def test_keyed_fold_float64_raises(self, mesh8):
+        h1, h2 = hashing.hash_keys(np.array([1] * 4))
+        with pytest.raises(ValueError, match="float32"):
+            mesh_keyed_fold(mesh8, h1, h2, np.ones(4, dtype=np.float64), "sum")
+
+    def test_global_sum_large_int_raises(self, mesh8):
+        with pytest.raises(ValueError, match="32-bit"):
+            mesh_global_sum(mesh8, np.array([2 ** 40, 5], dtype=np.int64))
+
+    def test_global_sum_near_limit_exact(self, mesh8):
+        vals = np.full(1000, 2 ** 20, dtype=np.int64)  # sum ~1e9 < 2**31
+        assert mesh_global_sum(mesh8, vals) == 1000 * 2 ** 20
+
+
+class TestIndexerQuoting:
+    def test_keys_with_quotes_do_not_crash(self, tmp_path):
+        from dampr_tpu.utils import Indexer
+        d = tmp_path / "docs"
+        d.mkdir()
+        (d / "doc.txt").write_text('say "hi" there\nplain line\n')
+        idx = Indexer(str(d / "*.txt"))
+        idx.build(lambda line: line.split())
+        out = [l.strip() for l in idx.union(['"hi"']).read()]
+        assert out == ['say "hi" there']
+        # injection attempt returns nothing instead of executing
+        evil = idx.union(['") ; drop table key_index; --']).read()
+        assert evil == []
