@@ -220,7 +220,9 @@ class PMap(PBase):
                 .map(_average))
 
     def len(self):
-        """Count all items in the collection."""
+        """Count all items in the collection.  With no pending per-record ops
+        the map side uses a vectorized record counter (newline counting on
+        raw text chunks); semantics are identical either way."""
         def _map_count(items):
             count = 0
             for _ in items:
@@ -237,7 +239,12 @@ class PMap(PBase):
             if not_empty:
                 yield 1, count
 
-        return (self.partition_map(_map_count)
+        if not self.agg:
+            from .ops.text import CountRecords
+            head = self.custom_mapper(CountRecords())
+        else:
+            head = self.partition_map(_map_count)
+        return (head
                 .partition_reduce(_reduce_count)
                 .map(lambda x: x[1]))
 

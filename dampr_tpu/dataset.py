@@ -162,6 +162,28 @@ class TextLineDataset(Dataset):
                 if self.end is not None and pos > self.end:
                     break
 
+    def read_bytes(self):
+        """The chunk's owned bytes as one buffer (for vectorized block
+        mappers).  Exactly the bytes of the lines ``read()`` yields: skip
+        through the first newline when start > 0, extend through the line
+        that crosses ``end``."""
+        with open(self.path, "rb") as f:
+            real_start = self.start
+            if self.start > 0:
+                f.seek(self.start)
+                f.readline()
+                real_start = f.tell()
+            if self.end is None:
+                f.seek(real_start)
+                return f.read()
+            if real_start > self.end:
+                return b""
+            f.seek(self.end)
+            f.readline()
+            real_end = f.tell()
+            f.seek(real_start)
+            return f.read(real_end - real_start)
+
     def __repr__(self):
         return "Text[path={},start={},end={}]".format(
             self.path, self.start, self.end)
@@ -180,6 +202,20 @@ class GzipLineDataset(Dataset):
             for raw in f:
                 yield pos, raw.decode("utf-8").rstrip("\n")
                 pos += len(raw)
+
+    def read_bytes(self):
+        with gzip.open(self.path, "rb") as f:
+            return f.read()
+
+    def iter_byte_blocks(self, block_size=4 * 1024 ** 2):
+        """Stream decompressed bytes in bounded blocks (so consumers that
+        only scan — record counting — never hold the whole expansion)."""
+        with gzip.open(self.path, "rb") as f:
+            while True:
+                b = f.read(block_size)
+                if not b:
+                    return
+                yield b
 
     def __repr__(self):
         return "GzipFile[path={}]".format(self.path)

@@ -179,12 +179,20 @@ class MTRunner(object):
 
         def job(chunk):
             mapper = _clone_op(stage.mapper)
-            if supplementary:
+            builder = BlockBuilder(settings.batch_size)
+            raw, partials = [], []
+            # Vectorized block protocol: mappers exposing map_blocks consume
+            # the chunk's raw bytes and emit whole Blocks, skipping the
+            # per-record Python path entirely (the SURVEY §7 dual-path).
+            use_blocks = (not supplementary
+                          and hasattr(mapper, "map_blocks")
+                          and hasattr(chunk, "read_bytes"))
+            if use_blocks:
+                kvs = None
+            elif supplementary:
                 kvs = mapper.map(chunk, *supplementary)
             else:
                 kvs = mapper.map(chunk)
-            builder = BlockBuilder(settings.batch_size)
-            raw, partials = [], []
 
             def take(blk):
                 if blk is None or not len(blk):
@@ -199,9 +207,13 @@ class MTRunner(object):
                 else:
                     raw.append(blk)
 
-            for k, v in kvs:
-                take(builder.add(k, v))
-            take(builder.flush())
+            if use_blocks:
+                for blk in mapper.map_blocks(chunk):
+                    take(blk)
+            else:
+                for k, v in kvs:
+                    take(builder.add(k, v))
+                take(builder.flush())
 
             if combine_op is not None and partials:
                 raw = [segment.fold_block(Block.concat(partials), combine_op)]

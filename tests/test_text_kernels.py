@@ -1,0 +1,172 @@
+"""Vectorized text kernels: native C++ pass vs numpy fallback vs pure-Python
+ground truth, plus the block-protocol integration through the DSL."""
+
+import collections
+import operator
+import re
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, native, settings
+from dampr_tpu.ops import text as T
+
+SAMPLE = (
+    "The quick brown fox jumps over the lazy dog\n"
+    "the quick BROWN fox, the dog!\n"
+    "\n"
+    "edge-case: under_scores and digits 123 mixed42tokens\n"
+    "trailing line without newline"
+).encode()
+
+RX = re.compile(r"[^\w]+")
+
+
+def py_word_counts(data):
+    return collections.Counter(data.decode().split())
+
+
+def py_doc_freq(data):
+    c = collections.Counter()
+    for line in data.decode().split("\n"):
+        c.update(t for t in set(RX.split(line.lower())) if t)
+    return c
+
+
+@pytest.fixture(autouse=True)
+def small_partitions():
+    old = settings.partitions
+    settings.partitions = 8
+    yield
+    settings.partitions = old
+
+
+class TestChunkKernels:
+    def test_token_counts_exact(self):
+        got = {k: v[1] for k, v in T.chunk_token_counts(SAMPLE).iter_pairs()}
+        assert got == dict(py_word_counts(SAMPLE))
+
+    def test_doc_freq_exact(self):
+        got = {k: v[1] for k, v in T.chunk_doc_freq(SAMPLE).iter_pairs()}
+        assert got == dict(py_doc_freq(SAMPLE))
+
+    def test_native_and_numpy_agree(self):
+        data = open("/root/reference/README.md", "rb").read() * 7
+        import dampr_tpu.native as nat
+        blk_native = T.chunk_doc_freq(data)
+        old = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True  # force numpy fallback
+        try:
+            blk_numpy = T.chunk_doc_freq(data)
+        finally:
+            nat._lib, nat._tried = old
+        a = {k: v[1] for k, v in blk_native.iter_pairs()}
+        b = {k: v[1] for k, v in blk_numpy.iter_pairs()}
+        assert a == b
+
+    def test_hashes_match_hash_keys(self):
+        # Tokens must group with equal Python-string keys engine-wide.
+        from dampr_tpu.ops import hashing
+        blk = T.chunk_token_counts(b"alpha beta gamma alpha")
+        kh1, kh2 = hashing.hash_keys(blk.keys)
+        np.testing.assert_array_equal(blk.h1, kh1)
+        np.testing.assert_array_equal(blk.h2, kh2)
+
+    def test_empty_and_separator_only(self):
+        assert len(T.chunk_token_counts(b"")) == 0
+        assert len(T.chunk_doc_freq(b"...!!!\n\n")) == 0
+
+    def test_vocab_growth_past_table_resize(self):
+        # >64k distinct tokens forces the native hash table to grow
+        data = " ".join("tok%d" % i for i in range(200000)).encode()
+        got = {k: v[1] for k, v in T.chunk_token_counts(data).iter_pairs()}
+        assert len(got) == 200000
+        assert all(v == 1 for v in got.values())
+
+
+class TestDSLIntegration:
+    def test_token_counts_pipeline_multi_chunk(self, tmp_path):
+        p = str(tmp_path / "c.txt")
+        data = (open("/root/reference/README.md").read()) * 9
+        with open(p, "w") as f:
+            f.write(data)
+        got = dict(
+            Dampr.text(p, chunk_size=8192)
+            .custom_mapper(T.TokenCounts())
+            .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+            .read())
+        assert got == dict(py_word_counts(data.encode()))
+
+    def test_doc_freq_pipeline_multi_chunk(self, tmp_path):
+        p = str(tmp_path / "d.txt")
+        data = (open("/root/reference/README.md").read()) * 9
+        with open(p, "w") as f:
+            f.write(data)
+        got = dict(
+            Dampr.text(p, chunk_size=8192)
+            .custom_mapper(T.DocFreq())
+            .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+            .read())
+        assert got == dict(py_doc_freq(data.encode()))
+
+    def test_len_vectorized_matches_python(self, tmp_path):
+        p = str(tmp_path / "l.txt")
+        with open(p, "w") as f:
+            f.write("a\nb\nc\nd with words\n")
+        assert Dampr.text(p, chunk_size=4).len().read() == [4]
+        # unterminated final line
+        p2 = str(tmp_path / "l2.txt")
+        with open(p2, "w") as f:
+            f.write("a\nb\nno-newline")
+        assert Dampr.text(p2, chunk_size=5).len().read() == [3]
+        # after per-record ops the generic Python path runs
+        assert (Dampr.memory(list(range(7))).map(lambda x: x).len().read()
+                == [7])
+
+    def test_fallback_map_on_memory_input(self):
+        # no read_bytes -> per-record fallback, same results
+        lines = ["a b a", "b c"]
+        got = dict(Dampr.memory(lines)
+                   .custom_mapper(T.TokenCounts())
+                   .fold_by(lambda kv: kv[0], operator.add,
+                            lambda kv: kv[1]).read())
+        assert got == {"a": 2, "b": 2, "c": 1}
+
+
+class TestReviewRegressions:
+    def test_long_tokens_numpy_fallback_exact(self):
+        # 300-char tokens previously got uninitialized hashes on fallback
+        import dampr_tpu.native as nat
+        long_tok = "x" * 300
+        data = ("a {t} b {t} c".format(t=long_tok)).encode()
+        old = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True
+        try:
+            got = {k: v[1] for k, v in T.chunk_token_counts(data).iter_pairs()}
+        finally:
+            nat._lib, nat._tried = old
+        assert got == {"a": 1, "b": 1, "c": 1, long_tok: 2}
+
+    def test_unicode_lower_native_matches_numpy(self):
+        data = "ÉCLAIR eclair\nÉCLAIR beta".encode()
+        a = {k: v[1] for k, v in T.chunk_doc_freq(data).iter_pairs()}
+        import dampr_tpu.native as nat
+        old = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True
+        try:
+            b = {k: v[1] for k, v in T.chunk_doc_freq(data).iter_pairs()}
+        finally:
+            nat._lib, nat._tried = old
+        assert a == b
+        from dampr_tpu.ops import hashing
+        blk = T.chunk_doc_freq(data)
+        kh1, _ = hashing.hash_keys(blk.keys)
+        np.testing.assert_array_equal(blk.h1, kh1)
+
+    def test_gzip_len_streams(self, tmp_path):
+        import gzip as gz
+        p = str(tmp_path / "z.gz")
+        with gz.open(p, "wt") as f:
+            for i in range(1000):
+                f.write("line %d\n" % i)
+        assert Dampr.text(p).len().read() == [1000]
