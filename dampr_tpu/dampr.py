@@ -37,10 +37,12 @@ from .runner import MTRunner
 
 class ValueEmitter(object):
     """Reads values from a completed run — the shell-friendly result handle
-    (reference dampr.py:19-51)."""
+    (reference dampr.py:19-51).  ``stats`` holds the run's per-stage metrics
+    (jobs, records, seconds) — observability the reference lacks."""
 
     def __init__(self, dataset):
         self.dataset = dataset
+        self.stats = []
 
     def stream(self):
         for _k, v in self.dataset.read():
@@ -69,11 +71,15 @@ class PBase(object):
         self.pmer = pmer
 
     def run(self, name=None, **kwargs):
-        """Evaluate the composed graph; returns a ValueEmitter."""
+        """Evaluate the composed graph; returns a ValueEmitter (its ``stats``
+        attribute carries per-stage timing/record counters)."""
         if name is None:
             name = "dampr/{}".format(random.random())
-        ds = self.pmer.runner(name, self.pmer.graph, **kwargs).run([self.source])
-        return ValueEmitter(ds[0])
+        runner = self.pmer.runner(name, self.pmer.graph, **kwargs)
+        ds = runner.run([self.source])
+        em = ValueEmitter(ds[0])
+        em.stats = [s.as_dict() for s in getattr(runner, "stats", [])]
+        return em
 
     def read(self, k=None, **kwargs):
         """Shorthand for run() + read()."""
@@ -533,8 +539,15 @@ class Dampr(object):
             sources.append(pmer.source)
 
         name = kwargs.pop("name", "dampr/{}".format(random.random()))
-        ds = pmer.pmer.runner(name, graph, **kwargs).run(sources)
-        return [ValueEmitter(d) for d in ds]
+        runner = pmer.pmer.runner(name, graph, **kwargs)
+        ds = runner.run(sources)
+        stats = [s.as_dict() for s in getattr(runner, "stats", [])]
+        emitters = []
+        for d in ds:
+            em = ValueEmitter(d)
+            em.stats = stats
+            emitters.append(em)
+        return emitters
 
     # -- graph builders (value semantics) ----------------------------------
     def _add_mapper(self, *args, **kwargs):

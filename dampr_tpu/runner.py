@@ -231,13 +231,43 @@ class MTRunner(object):
         results = self._pool_run(job, chunks, n_maps)
 
         pset = storage.PartitionSet(P)
-        nrec = 0
         for mapping in results:
             for pid, refs in mapping.items():
                 for ref in refs:
-                    nrec += len(ref)
                     pset.add(pid, ref)
-        return pset, nrec, len(chunks)
+        self._compact_partitions(pset, combine_op, pin)
+        return pset, pset.total_records(), len(chunks)
+
+    def _compact_partitions(self, pset, combine_op, pin):
+        """Block-count governor (the reference's file-count combiner rounds,
+        runner.py:293-320): partitions holding more than max_files_per_stage
+        refs merge — re-folding under the stage's associative op when present
+        — so ref counts and reduce-side fan-in stay bounded.
+
+        Memory discipline: refs merge in rounds of at most ``limit`` at a
+        time, and each round's source refs are dropped from the store before
+        the merged block registers, so peak residency stays one round's worth
+        over budget instead of the whole partition (and near-budget source
+        refs never get pointlessly spilled just to be deleted)."""
+        limit = max(2, settings.max_files_per_stage)
+        for pid, refs in list(pset.parts.items()):
+            while len(refs) > limit:
+                merged_refs = []
+                for at in range(0, len(refs), limit):
+                    round_refs = refs[at:at + limit]
+                    if len(round_refs) == 1:
+                        merged_refs.append(round_refs[0])
+                        continue
+                    blocks = [r.get() for r in round_refs]
+                    for r in round_refs:
+                        self.store.drop_ref(r)
+                    merged = Block.concat(blocks)
+                    del blocks
+                    if combine_op is not None:
+                        merged = segment.fold_block(merged, combine_op)
+                    merged_refs.append(self.store.register(merged, pin=pin))
+                refs = merged_refs
+            pset.parts[pid] = refs
 
     # -- reduce ------------------------------------------------------------
     def run_reduce(self, stage_id, stage, env):

@@ -1,0 +1,73 @@
+"""Runner-level semantics: block-count governor, per-run stats, fail-fast."""
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+
+
+@pytest.fixture(autouse=True)
+def small_partitions():
+    old = settings.partitions
+    settings.partitions = 4
+    yield
+    settings.partitions = old
+
+
+class TestGovernor:
+    def test_partition_ref_count_bounded(self):
+        old = settings.max_files_per_stage
+        settings.max_files_per_stage = 3
+        try:
+            # 40 memory chunks -> up to 40 refs per partition without the
+            # governor; with it, each partition compacts to one ref.
+            pipe = (Dampr.memory(list(range(400)), partitions=40)
+                    .checkpoint(True))
+            from dampr_tpu.runner import MTRunner
+            runner = MTRunner("govern", pipe.pmer.graph)
+            out = runner.run([pipe.source])
+            pset = out[0].pset
+            assert all(len(refs) <= 3 for refs in pset.parts.values())
+            assert sorted(v for _k, v in out[0].read()) == list(range(400))
+        finally:
+            settings.max_files_per_stage = old
+
+    def test_governor_refolds_combined_stages(self):
+        old = settings.max_files_per_stage
+        settings.max_files_per_stage = 2
+        try:
+            out = dict(Dampr.memory(list(range(1000)), partitions=50)
+                       .count(lambda x: x % 5).read())
+            assert out == {i: 200 for i in range(5)}
+        finally:
+            settings.max_files_per_stage = old
+
+
+class TestStats:
+    def test_emitter_stats_populated(self):
+        em = Dampr.memory([1, 2, 3]).map(lambda x: x + 1).run()
+        assert em.stats, "run stats missing"
+        kinds = [s["kind"] for s in em.stats]
+        assert "map" in kinds
+        assert all({"jobs", "records_out", "seconds"} <= set(s)
+                   for s in em.stats)
+
+    def test_multi_run_stats(self):
+        a, b = Dampr.run(Dampr.memory([1]).map(lambda x: x),
+                         Dampr.memory([2]).map(lambda x: x))
+        assert a.stats and a.stats == b.stats
+
+
+class TestFailFast:
+    def test_map_exception_propagates(self):
+        def boom(x):
+            raise RuntimeError("map exploded")
+
+        with pytest.raises(RuntimeError, match="map exploded"):
+            Dampr.memory([1, 2, 3]).map(boom).read()
+
+    def test_reduce_exception_propagates(self):
+        def boom(k, it):
+            raise ValueError("reduce exploded")
+
+        with pytest.raises(ValueError, match="reduce exploded"):
+            Dampr.memory([1, 2, 3]).group_by(lambda x: 1).reduce(boom).read()
