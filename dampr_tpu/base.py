@@ -222,6 +222,92 @@ class MapAllJoin(Mapper):
 # Grouped partition views (what reducers consume)
 # ---------------------------------------------------------------------------
 
+class StreamingGroupedView(object):
+    """Out-of-core grouped view: a k-way merge over hash-sorted runs, holding
+    one bounded window per run instead of the whole partition (the reference's
+    ``MergeDataset`` heap merge over sorted spill files, dataset.py:567-588,
+    restated over columnar runs).
+
+    Groups stream in **hash order**, not key order — the documented contract
+    when a partition exceeds the memory budget (key order would require
+    materializing everything; the reference pays sorted-spill cost up front
+    instead).  Within one 64-bit hash, records sub-group exactly by real key.
+    """
+
+    def __init__(self, refs):
+        self.refs = refs
+
+    def _run_stream(self, ref, run_idx):
+        for window in ref.iter_windows():
+            keys, vals = window.keys, window.values
+            h1, h2 = window.hashes()
+            for i in range(len(keys)):
+                k = keys[i]
+                v = vals[i]
+                yield (int(h1[i]), int(h2[i]), run_idx,
+                       k.item() if isinstance(k, np.generic) else k,
+                       v.item() if isinstance(v, np.generic) else v)
+
+    def grouped_read(self):
+        """Yield (key, value_iter) per group, groupby-style: advancing to the
+        next group drains the previous iterator.  The common (no-collision)
+        case streams a hash-group's values lazily — a hot key never buffers —
+        and only records of *other* keys colliding in the same 64-bit hash
+        (astronomically rare, tiny) are set aside and re-grouped exactly."""
+        import heapq
+
+        streams = [self._run_stream(ref, i) for i, ref in enumerate(self.refs)]
+        merged = heapq.merge(*streams, key=lambda r: (r[0], r[1], r[2]))
+        rec = next(merged, None)
+        holder = [None]
+        while rec is not None:
+            h = (rec[0], rec[1])
+            key = rec[3]
+            pending = []  # same-hash records of OTHER keys (collisions)
+
+            def values(first=rec, h=h, key=key):
+                yield first[4]
+                while True:
+                    r = next(merged, None)
+                    if r is None or (r[0], r[1]) != h:
+                        holder[0] = r
+                        return
+                    if r[3] == key:
+                        yield r[4]
+                    else:
+                        pending.append(r)
+
+            gen = values()
+            holder[0] = None
+            yield key, gen
+            # groupby contract: drain whatever the caller left unconsumed so
+            # the merge advances past this group (values are dropped, not
+            # stored — memory stays bounded).
+            for _ in gen:
+                pass
+            for k2, vs2 in _group_small(pending):
+                yield k2, iter(vs2)
+            rec = holder[0]
+
+    def read(self):
+        for k, vs in self.grouped_read():
+            for v in vs:
+                yield k, v
+
+
+def _group_small(records):
+    """Exact first-seen-order grouping of a handful of collision records."""
+    by_key = []
+    for rec in records:
+        for entry in by_key:
+            if entry[0] == rec[3]:
+                entry[1].append(rec[4])
+                break
+        else:
+            by_key.append((rec[3], [rec[4]]))
+    return by_key
+
+
 class GroupedView(object):
     """Key-sorted grouped view over one input's blocks within a partition.
 

@@ -153,6 +153,13 @@ class Block(object):
             None if self.h2 is None else self.h2.take(idx),
         )
 
+    def sort_by_hash(self):
+        """Stable sort by the (h1, h2) lanes — makes the block a mergeable
+        run; equal keys (equal hashes) keep arrival order."""
+        h1, h2 = self.hashes()
+        order = np.lexsort((h2, h1))
+        return self.take(order)
+
     def partition_ids(self, n_partitions):
         h1, _ = self.hashes()
         return (h1 % np.uint32(n_partitions)).astype(np.int32)

@@ -176,6 +176,13 @@ class MTRunner(object):
 
         pin = bool(stage.options.get("memory"))
         P = self.n_partitions
+        # Hash-sorted runs are only needed when a reduce consumes this output
+        # (it's what the over-budget streaming merge relies on); stages
+        # feeding sinks or final reads skip the sort — their consumers
+        # re-order by key anyway.
+        feeds_reduce = any(
+            isinstance(s, GReduce) and stage.output in s.inputs
+            for s in self.graph.stages)
 
         def job(chunk):
             mapper = _clone_op(stage.mapper)
@@ -220,8 +227,14 @@ class MTRunner(object):
 
             # Register with the store *inside* the job so the memory budget is
             # enforced while the stage runs, not after all jobs complete.
+            # Every registered block is a hash-sorted run (fold outputs
+            # already are; raw blocks sort here — stable, so equal keys keep
+            # input order), which is what lets over-budget reduces stream a
+            # k-way merge instead of materializing the partition.
             out = {}
             for blk in raw:
+                if combine_op is None and feeds_reduce:
+                    blk = blk.sort_by_hash()
                 for pid, sub in blk.split_by_partition(P).items():
                     out.setdefault(pid, []).append(
                         self.store.register(sub, pin=pin))
@@ -235,10 +248,10 @@ class MTRunner(object):
             for pid, refs in mapping.items():
                 for ref in refs:
                     pset.add(pid, ref)
-        self._compact_partitions(pset, combine_op, pin)
+        self._compact_partitions(pset, combine_op, pin, feeds_reduce)
         return pset, pset.total_records(), len(chunks)
 
-    def _compact_partitions(self, pset, combine_op, pin):
+    def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True):
         """Block-count governor (the reference's file-count combiner rounds,
         runner.py:293-320): partitions holding more than max_files_per_stage
         refs merge — re-folding under the stage's associative op when present
@@ -265,6 +278,10 @@ class MTRunner(object):
                     del blocks
                     if combine_op is not None:
                         merged = segment.fold_block(merged, combine_op)
+                    elif feeds_reduce:
+                        # keep the run invariant: merged blocks stay
+                        # hash-sorted so streaming reduces can merge them
+                        merged = merged.sort_by_hash()
                     merged_refs.append(self.store.register(merged, pin=pin))
                 refs = merged_refs
             pset.parts[pid] = refs
@@ -279,11 +296,37 @@ class MTRunner(object):
         P = self.n_partitions
         pin = bool(stage.options.get("memory"))
 
+        threshold = settings.streaming_reduce_threshold
+        if threshold is None:
+            threshold = settings.max_memory_per_stage
+        # The streaming merge yields groups in hash order, not key order —
+        # safe for per-group reducers (Reduce/KeyedReduce/AssocFoldReducer,
+        # where each group is independent), but Stream/BlockReducers observe
+        # the group sequence directly, so they always get the key-ordered
+        # materialized view.
+        order_insensitive = isinstance(
+            stage.reducer, (base.Reduce, base.AssocFoldReducer))
+
         def job(pid):
             views = []
             for pset in entries:
-                blocks = [ref.get() for ref in pset.refs(pid)]
-                views.append(base.GroupedView(blocks))
+                refs = pset.refs(pid)
+                part_bytes = sum(r.nbytes for r in refs)
+                if (len(entries) == 1 and order_insensitive
+                        and part_bytes > threshold):
+                    # Out-of-core partition: stream a k-way merge over the
+                    # hash-sorted runs — one window per run resident — instead
+                    # of materializing the whole partition.  (Joins keep the
+                    # materialized key-ordered path; their walk contract is
+                    # key order on both sides.)
+                    log.info(
+                        "partition %d (%.1f MB) exceeds the streaming "
+                        "threshold: groups will stream in hash order",
+                        pid, part_bytes / 1e6)
+                    views.append(base.StreamingGroupedView(refs))
+                else:
+                    views.append(base.GroupedView(
+                        [ref.get() for ref in refs]))
             reducer = _clone_op(stage.reducer)
             builder = BlockBuilder(settings.batch_size)
             refs = []

@@ -68,3 +68,9 @@ shuffle_capacity_factor = 1.5
 #: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")
+
+#: Partition-size threshold (bytes) above which a single-input reduce streams
+#: a k-way merge over hash-sorted runs instead of materializing the partition
+#: (groups then arrive in hash order, not key order).  None = use
+#: max_memory_per_stage.
+streaming_reduce_threshold = None
