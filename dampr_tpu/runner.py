@@ -140,6 +140,7 @@ class MTRunner(object):
         self.n_partitions = n_partitions or settings.partitions
         self.store = storage.RunStore(name, budget=memory_budget)
         self.stats = []
+        self.mesh_folds = 0  # reduces executed via the mesh collective path
 
     # -- job fan-out --------------------------------------------------------
     def _pool_run(self, fn, jobs, n_workers):
@@ -287,12 +288,120 @@ class MTRunner(object):
             pset.parts[pid] = refs
 
     # -- reduce ------------------------------------------------------------
+    def _mesh_reduce(self, stage, entries):
+        """Distributed fast path for device-foldable associative reduces: one
+        mesh collective program (local fold -> all_to_all by hash ->
+        final fold) over every partition at once, replacing per-partition
+        host jobs.  Returns None whenever the host path is required for
+        exactness — object values, 32-bit lane overflow, a 64-bit key
+        collision, or an over-budget working set."""
+        mode = str(settings.mesh_fold).lower()
+        if mode in ("off", "0", "false") or not settings.use_device:
+            return None
+        if len(entries) != 1 or not isinstance(stage.reducer,
+                                               base.AssocFoldReducer):
+            return None
+        op = stage.reducer.op
+        if op.kind not in ("sum", "min", "max"):
+            return None
+        import jax
+
+        if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+            return None
+
+        refs = list(entries[0].all_refs())
+        if not refs:
+            return storage.PartitionSet(self.n_partitions), 0, 1
+        # Cheap metadata checks before touching any (possibly spilled) data.
+        if any(getattr(r, "value_dtype", object) == object for r in refs):
+            return None
+        if sum(r.nbytes for r in refs) > settings.max_memory_per_stage:
+            return None
+        # Load incrementally, verifying 32-bit lane exactness per block (the
+        # abs-sum bound accumulates across blocks so per-group sums stay
+        # bounded) — bail before concatenating when any block disqualifies.
+        blocks = []
+        abs_sum = 0
+        for r in refs:
+            blk = r.get()
+            vals = blk.values
+            if vals.dtype == np.bool_:
+                vals = vals.astype(np.int64)
+                blk = Block(blk.keys, vals, blk.h1, blk.h2)
+            if vals.dtype == np.float64:
+                return None
+            if vals.dtype == np.int64 and not jax.config.jax_enable_x64:
+                if not len(vals):
+                    pass
+                elif (int(vals.min()) < -(2 ** 31 - 1) - 1
+                      or int(vals.max()) > 2 ** 31 - 1):
+                    return None
+                else:
+                    abs_sum += int(np.abs(vals).sum())
+                    if op.kind == "sum" and abs_sum > 2 ** 31 - 1:
+                        return None
+            blocks.append(blk)
+        cat = Block.concat(blocks)
+        del blocks
+
+        # Group on host once: vectorized hash sort + exact key repair gives
+        # both the collision check (adjacent groups sharing a 64-bit hash)
+        # and a vocabulary-sized hash->key table, replacing any per-record
+        # Python pass.
+        groups = segment.sort_and_group(cat)
+        starts, _ends = groups.bounds()
+        sb = groups.block
+        gh1 = sb.h1.take(starts)
+        gh2 = sb.h2.take(starts)
+        if len(starts) > 1 and bool(
+                np.any((gh1[1:] == gh1[:-1]) & (gh2[1:] == gh2[:-1]))):
+            log.info("mesh fold: 64-bit key collision, using host path")
+            return None
+        gkeys = sb.keys.take(starts)
+        lookup = {}
+        for i in range(len(starts)):
+            k = gkeys[i]
+            lookup[(int(gh1[i]), int(gh2[i]))] = (
+                k.item() if isinstance(k, np.generic) else k)
+
+        from .blocks import _column_from_list
+        from .parallel import mesh_keyed_fold
+        from .parallel.mesh import data_mesh
+
+        try:
+            fh1, fh2, fv = mesh_keyed_fold(data_mesh(), sb.h1, sb.h2,
+                                           sb.values, op.kind)
+        except ValueError:
+            return None
+
+        P = self.n_partitions
+        pin = bool(stage.options.get("memory"))
+        keys_list = [lookup[(int(a), int(b))] for a, b in zip(fh1, fh2)]
+        vcol = np.empty(len(keys_list), dtype=object)
+        for i, k in enumerate(keys_list):
+            v = fv[i]
+            vcol[i] = (k, v.item() if isinstance(v, np.generic) else v)
+        out_blk = Block(_column_from_list(keys_list), vcol, fh1, fh2)
+
+        pset = storage.PartitionSet(P)
+        nrec = 0
+        for pid, sub in out_blk.split_by_partition(P).items():
+            nrec += len(sub)
+            pset.add(pid, self.store.register(sub, pin=pin))
+        self.mesh_folds += 1
+        log.info("mesh fold: %d keys folded across %d devices",
+                 nrec, len(jax.devices()))
+        return pset, nrec, 1
+
     def run_reduce(self, stage_id, stage, env):
         entries = [env[s] for s in stage.inputs]
         for e in entries:
             assert isinstance(e, storage.PartitionSet), (
                 "reduce inputs must be materialized partitions; the DSL "
                 "checkpoints before grouping")
+        fast = self._mesh_reduce(stage, entries)
+        if fast is not None:
+            return fast
         P = self.n_partitions
         pin = bool(stage.options.get("memory"))
 

@@ -65,6 +65,13 @@ device_min_batch = 4096
 #: (MoE-style capacity: per-(src,dst) buffer = ceil(N/D) * factor).
 shuffle_capacity_factor = 1.5
 
+#: Route device-foldable associative reduces through the mesh collective
+#: shuffle (local fold -> all_to_all -> final fold) instead of per-partition
+#: host jobs: "auto" = when more than one device is visible, "on", "off".
+#: Falls back to the host path whenever exactness can't be guaranteed
+#: (object values, 32-bit lane overflow, 64-bit key collisions).
+mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
+
 #: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")

@@ -28,13 +28,15 @@ log = logging.getLogger("dampr_tpu.storage")
 class BlockRef(object):
     """A handle to one materialized block: RAM-resident or spilled to disk."""
 
-    __slots__ = ("_block", "path", "nbytes", "nrecords", "store", "pin")
+    __slots__ = ("_block", "path", "nbytes", "nrecords", "value_dtype",
+                 "store", "pin")
 
     def __init__(self, block, store=None, pin=False):
         self._block = block
         self.path = None
         self.nbytes = block.nbytes()
         self.nrecords = len(block)
+        self.value_dtype = block.values.dtype  # metadata survives spilling
         self.store = store
         self.pin = pin
 
