@@ -484,6 +484,14 @@ class MTRunner(object):
 
     # -- main walk ---------------------------------------------------------
     def run(self, outputs, cleanup=True):
+        if settings.profile_dir:
+            import jax
+
+            with jax.profiler.trace(settings.profile_dir):
+                return self._run(outputs, cleanup)
+        return self._run(outputs, cleanup)
+
+    def _run(self, outputs, cleanup=True):
         env = {}
         to_delete = []
         n_stages = len(self.graph.stages)

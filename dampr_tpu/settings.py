@@ -76,6 +76,11 @@ mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")
 
+#: When set, every run is wrapped in a jax.profiler trace written under this
+#: directory (view with TensorBoard / xprof).  Structured per-stage metrics
+#: are always available via ValueEmitter.stats regardless.
+profile_dir = os.environ.get("DAMPR_TPU_PROFILE_DIR") or None
+
 #: Partition-size threshold (bytes) above which a single-input reduce streams
 #: a k-way merge over hash-sorted runs instead of materializing the partition
 #: (groups then arrive in hash order, not key order).  None = use

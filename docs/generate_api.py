@@ -1,0 +1,101 @@
+"""Render docs/API.md from the package's docstrings (the reference ships a
+pdoc-generated API reference, docs/dampr/index.html; this is the equivalent
+without a pdoc dependency).
+
+Run: python docs/generate_api.py
+"""
+
+import importlib
+import inspect
+import os
+
+MODULES = [
+    "dampr_tpu",
+    "dampr_tpu.dampr",
+    "dampr_tpu.base",
+    "dampr_tpu.blocks",
+    "dampr_tpu.dataset",
+    "dampr_tpu.inputs",
+    "dampr_tpu.graph",
+    "dampr_tpu.runner",
+    "dampr_tpu.storage",
+    "dampr_tpu.settings",
+    "dampr_tpu.ops.hashing",
+    "dampr_tpu.ops.segment",
+    "dampr_tpu.ops.text",
+    "dampr_tpu.parallel",
+    "dampr_tpu.parallel.shuffle",
+    "dampr_tpu.parallel.sgd",
+    "dampr_tpu.native",
+    "dampr_tpu.utils",
+    "dampr_tpu.utils.indexer",
+    "dampr_tpu.utils.common",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _doc(obj, indent=""):
+    d = inspect.getdoc(obj)
+    if not d:
+        return ""
+    return "\n".join(indent + line for line in d.splitlines())
+
+
+def render_module(name, out):
+    mod = importlib.import_module(name)
+    out.append("\n## `{}`\n".format(name))
+    d = _doc(mod)
+    if d:
+        out.append(d + "\n")
+
+    members = vars(mod)
+    for attr, obj in sorted(members.items()):
+        if attr.startswith("_"):
+            continue
+        if getattr(obj, "__module__", None) != name:
+            continue
+        if inspect.isclass(obj):
+            out.append("\n### class `{}.{}`\n".format(name, attr))
+            d = _doc(obj)
+            if d:
+                out.append(d + "\n")
+            for mname, meth in sorted(vars(obj).items()):
+                if mname.startswith("_") or not callable(meth):
+                    continue
+                fn = meth.__func__ if isinstance(
+                    meth, (classmethod, staticmethod)) else meth
+                out.append("- **`{}{}`**".format(mname, _sig(fn)))
+                md = inspect.getdoc(fn)
+                if md:
+                    out.append("  - {}".format(md.splitlines()[0]))
+        elif inspect.isfunction(obj):
+            out.append("\n### `{}.{}{}`\n".format(name, attr, _sig(obj)))
+            d = _doc(obj)
+            if d:
+                out.append(d + "\n")
+
+
+def main():
+    out = [
+        "# dampr_tpu API reference",
+        "",
+        "*Generated from docstrings by `docs/generate_api.py` — regenerate "
+        "after changing public surfaces.*",
+    ]
+    for name in MODULES:
+        render_module(name, out)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "API.md")
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    print("wrote", path, "({} lines)".format(sum(s.count("\n") + 1
+                                                 for s in out)))
+
+
+if __name__ == "__main__":
+    main()

@@ -460,3 +460,39 @@ class TestUtils:
         inter = sorted(l.strip() for l in idx.intersect(
             ["apple", "banana"]).read())
         assert inter == ["apple banana"]
+
+
+class TestReferenceEdgeBehaviors:
+    """Edge behaviors ported from the reference suite (test_dampr.py)."""
+
+    def test_count_none_keys(self, items):
+        # count(lambda x: None): all records share the None key
+        out = items.count(lambda x: None).read()
+        assert out == [(None, 10)]
+
+    def test_repartition_disjoint_join_empty(self, items):
+        # group_by different key fns -> co-partitioned by hash; disjoint key
+        # spaces join to nothing (reference test_repartition)
+        items2 = (Dampr.memory(list(range(10)))
+                  .group_by(lambda x: -x).reduce(lambda k, vs: sum(vs)))
+        out = items.group_by(lambda x: x).join(items2).run().read()
+        assert out == []
+
+    def test_cross_join_self(self, items):
+        # cross of a source with itself: shared graph prefix dedups
+        out = items.cross_left(items, lambda v1, v2: v1 * v2).run().read()
+        expected = sorted(i * k for i in range(10, 20) for k in range(10, 20))
+        assert sorted(out) == expected
+
+    def test_cross_with_computed_total(self, items):
+        item_counts = items.count()
+        total = (items.a_group_by(lambda x: 1, lambda x: 1).sum()
+                 .map(lambda x: float(x[1])))
+        results = item_counts.cross_right(
+            total, lambda ic, t: (ic[0], ic[1] / t)).read()
+        assert sorted(results) == [(i, 0.1) for i in range(10, 20)]
+
+    def test_group_by_single_key_via_run_iter(self, items):
+        res = (items.group_by(lambda x: 1, lambda x: 1)
+               .reduce(lambda k, it: sum(it)).run())
+        assert next(iter(res))[1] == 10
