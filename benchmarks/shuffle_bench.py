@@ -1,0 +1,72 @@
+"""ICI shuffle microbenchmark (BASELINE.md config: "shuffle all-to-all
+bandwidth"): times the full mesh keyed-fold program (local segment fold ->
+all_to_all -> final fold) and the ring all-reduce over the visible mesh.
+
+On a single chip the collectives are loopback (upper bound); on a real slice
+the same program measures ICI.  Run on the virtual CPU mesh for a
+functional check:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/shuffle_bench.py --cpu
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=1 << 22)
+    ap.add_argument("--keys", type=int, default=1 << 16)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU mesh")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from dampr_tpu.ops import hashing
+    from dampr_tpu.parallel import mesh_keyed_fold
+    from dampr_tpu.parallel.mesh import data_mesh
+    from dampr_tpu.parallel.ring import ring_allreduce
+
+    mesh = data_mesh()
+    n_dev = len(jax.devices())
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, args.keys, size=args.records)
+    vals = np.ones(args.records, dtype=np.int32)
+    h1, h2 = hashing.hash_keys(keys)
+    payload_mb = args.records * 12 / 1e6  # h1 + h2 + v
+
+    # warm (compile)
+    mesh_keyed_fold(mesh, h1, h2, vals, "sum")
+    t0 = time.time()
+    for _ in range(args.iters):
+        fh1, _fh2, fv = mesh_keyed_fold(mesh, h1, h2, vals, "sum")
+    fold_s = (time.time() - t0) / args.iters
+    assert int(fv.sum()) == args.records
+
+    x = rng.randn(n_dev * 1024, 256).astype(np.float32)
+    ring_allreduce(mesh, x)  # warm
+    t0 = time.time()
+    for _ in range(args.iters):
+        ring_allreduce(mesh, x)
+    ring_s = (time.time() - t0) / args.iters
+    ring_mb = x.nbytes / 1e6
+
+    print(json.dumps({
+        "devices": n_dev,
+        "keyed_fold_MBps": round(payload_mb / fold_s, 1),
+        "keyed_fold_records_per_s": round(args.records / fold_s),
+        "ring_allreduce_MBps": round(ring_mb / ring_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -487,6 +487,30 @@ class AssocFoldReducer(Reducer):
                 yield k, (k, acc)
 
 
+def _sort_merge_walk(g1, g2):
+    """The one sort-merge walk all joins share: yields
+    ``('both', k, lvals, rvals)`` on matched keys, ``('left', k, lvals)`` /
+    ``('right', k, rvals)`` on exclusives, in ascending key order (reference
+    base.py:259-315, deduplicated)."""
+    left, right = next(g1, None), next(g2, None)
+    while left is not None and right is not None:
+        if left[0] < right[0]:
+            yield ("left", left[0], left[1])
+            left = next(g1, None)
+        elif left[0] > right[0]:
+            yield ("right", right[0], right[1])
+            right = next(g2, None)
+        else:
+            yield ("both", left[0], left[1], right[1])
+            left, right = next(g1, None), next(g2, None)
+    while left is not None:
+        yield ("left", left[0], left[1])
+        left = next(g1, None)
+    while right is not None:
+        yield ("right", right[0], right[1])
+        right = next(g2, None)
+
+
 class InnerJoin(Reducer):
     """Sort-merge inner join over two co-partitioned grouped views
     (reference base.py:259-283)."""
@@ -497,22 +521,16 @@ class InnerJoin(Reducer):
 
     def reduce(self, *datasets):
         assert len(datasets) == 2
-        g1 = self.yield_groups(datasets[0])
-        g2 = self.yield_groups(datasets[1])
-        left, right = next(g1, None), next(g2, None)
-        while left is not None and right is not None:
-            if left[0] < right[0]:
-                left = next(g1, None)
-            elif left[0] > right[0]:
-                right = next(g2, None)
-            else:
-                k = left[0]
-                it = self.joiner_f(k, left[1], right[1])
-                if not self.many:
-                    it = [it]
-                for nv in it:
-                    yield k, nv
-                left, right = next(g1, None), next(g2, None)
+        walk = _sort_merge_walk(self.yield_groups(datasets[0]),
+                                self.yield_groups(datasets[1]))
+        for side, k, *vals in walk:
+            if side != "both":
+                continue
+            it = self.joiner_f(k, vals[0], vals[1])
+            if not self.many:
+                it = [it]
+            for nv in it:
+                yield k, nv
 
 
 class KeyedInnerJoin(InnerJoin):
@@ -531,28 +549,48 @@ class LeftJoin(Reducer):
 
     def reduce(self, *datasets):
         assert len(datasets) == 2
-        g1 = self.yield_groups(datasets[0])
-        g2 = self.yield_groups(datasets[1])
-        left, right = next(g1, None), next(g2, None)
-        while left is not None and right is not None:
-            k = left[0]
-            if left[0] < right[0]:
-                yield k, self.joiner_f(k, left[1], self.default())
-                left = next(g1, None)
-            elif left[0] > right[0]:
-                right = next(g2, None)
-            else:
-                yield k, self.joiner_f(k, left[1], right[1])
-                left, right = next(g1, None), next(g2, None)
-        while left is not None:
-            k = left[0]
-            yield k, self.joiner_f(k, left[1], self.default())
-            left = next(g1, None)
+        walk = _sort_merge_walk(self.yield_groups(datasets[0]),
+                                self.yield_groups(datasets[1]))
+        for side, k, *vals in walk:
+            if side == "both":
+                yield k, self.joiner_f(k, vals[0], vals[1])
+            elif side == "left":
+                yield k, self.joiner_f(k, vals[0], self.default())
 
 
 class KeyedLeftJoin(LeftJoin):
     def reduce(self, *datasets):
         for k, v in super(KeyedLeftJoin, self).reduce(*datasets):
+            yield k, (k, v)
+
+
+class OuterJoin(Reducer):
+    """Sort-merge full outer join; either side may be missing and sees
+    ``default()``.  The reference's OuterJoin is dead code with undefined-
+    variable bugs (reference base.py:355, 366 — never exposed by its DSL);
+    this is the corrected behavior, exposed as a new capability
+    (PJoin.outer_reduce)."""
+
+    def __init__(self, joiner_f, default=lambda: iter(())):
+        self.joiner_f = joiner_f
+        self.default = default
+
+    def reduce(self, *datasets):
+        assert len(datasets) == 2
+        walk = _sort_merge_walk(self.yield_groups(datasets[0]),
+                                self.yield_groups(datasets[1]))
+        for side, k, *vals in walk:
+            if side == "both":
+                yield k, self.joiner_f(k, vals[0], vals[1])
+            elif side == "left":
+                yield k, self.joiner_f(k, vals[0], self.default())
+            else:
+                yield k, self.joiner_f(k, self.default(), vals[0])
+
+
+class KeyedOuterJoin(OuterJoin):
+    def reduce(self, *datasets):
+        for k, v in super(KeyedOuterJoin, self).reduce(*datasets):
             yield k, (k, v)
 
 

@@ -25,9 +25,10 @@ import sys
 import threading
 import time
 
-from .base import (AssocFoldReducer, KeyedInnerJoin, KeyedLeftJoin, KeyedReduce,
-                   Map, MapAllJoin, MapCrossJoin, Mapper, PartialReduceCombiner,
-                   Reducer, StreamMapper, StreamReducer, Streamable, fuse)
+from .base import (AssocFoldReducer, KeyedInnerJoin, KeyedLeftJoin,
+                   KeyedOuterJoin, KeyedReduce, Map, MapAllJoin, MapCrossJoin,
+                   Mapper, PartialReduceCombiner, Reducer, StreamMapper,
+                   StreamReducer, Streamable, fuse)
 from .dataset import CatDataset, Chunker
 from .graph import Graph, Source
 from .inputs import MemoryInput, PathInput, UrlsInput
@@ -472,6 +473,17 @@ class PJoin(PBase):
 
         source, pmer = self.pmer._add_reducer(
             [self.source, self.right], KeyedLeftJoin(_reduce))
+        return PMap(source, pmer)
+
+    def outer_reduce(self, aggregate):
+        """Full outer join: whichever side is missing a key sees an empty
+        iterator.  (New capability — the reference defines but never exposes
+        an outer join, and its implementation is broken: base.py:355, 366.)"""
+        def _reduce(k, left, right):
+            return aggregate(left, right)
+
+        source, pmer = self.pmer._add_reducer(
+            [self.source, self.right], KeyedOuterJoin(_reduce))
         return PMap(source, pmer)
 
 

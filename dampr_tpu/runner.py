@@ -144,6 +144,25 @@ class MTRunner(object):
 
     # -- job fan-out --------------------------------------------------------
     def _pool_run(self, fn, jobs, n_workers):
+        retries = settings.job_retries
+        if retries:
+            inner = fn
+
+            def fn(job):  # noqa: F811 - deliberate retry wrapper
+                for attempt in range(retries + 1):
+                    try:
+                        # attempt() rolls back this attempt's block
+                        # registrations on failure so retries never orphan
+                        # refs against the memory budget.
+                        with self.store.attempt():
+                            return inner(job)
+                    except Exception:
+                        if attempt == retries:
+                            raise
+                        log.warning(
+                            "job failed (attempt %d/%d), retrying",
+                            attempt + 1, retries + 1, exc_info=True)
+
         n_workers = max(1, min(n_workers, len(jobs), settings.max_processes))
         if n_workers == 1 or len(jobs) <= 1:
             return [fn(j) for j in jobs]

@@ -76,6 +76,12 @@ mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")
 
+#: Per-job retry budget for transient failures (flaky IO/UDF): a failing map/
+#: reduce/sink job re-executes up to this many times before the run fails
+#: fast with the original traceback.  The reference deadlocks on a dead
+#: worker (stagerunner.py:35-38); 0 keeps plain fail-fast.
+job_retries = 0
+
 #: When set, every run is wrapped in a jax.profiler trace written under this
 #: directory (view with TensorBoard / xprof).  Structured per-stage metrics
 #: are always available via ValueEmitter.stats regardless.

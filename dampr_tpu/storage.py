@@ -12,6 +12,7 @@ Every stage output lives behind :class:`BlockRef`; the per-run
 stages) never spill.
 """
 
+import contextlib
 import gzip
 import logging
 import os
@@ -148,14 +149,37 @@ class RunStore(object):
         self._resident = []          # FIFO of RAM refs
         self._resident_bytes = 0
         self._stage = "stage_0"
+        self._attempts = threading.local()
         self.spill_count = 0
         self.spilled_bytes = 0
+
+    @contextlib.contextmanager
+    def attempt(self):
+        """Track every ref this thread registers inside the block; on
+        exception the refs are dropped, so a retried job's failed attempt
+        cannot orphan blocks against the memory budget."""
+        stack = getattr(self._attempts, "stack", None)
+        if stack is None:
+            stack = self._attempts.stack = []
+        refs = []
+        stack.append(refs)
+        try:
+            yield refs
+        except BaseException:
+            for ref in refs:
+                self.drop_ref(ref)
+            raise
+        finally:
+            stack.pop()
 
     def set_stage(self, stage_name):
         self._stage = "stage_{}".format(stage_name)
 
     def register(self, block, pin=False):
         ref = BlockRef(block, store=self, pin=pin)
+        stack = getattr(self._attempts, "stack", None)
+        if stack:
+            stack[-1].append(ref)
         with self._lock:
             self._resident.append(ref)
             self._resident_bytes += ref.nbytes

@@ -1,0 +1,132 @@
+"""New capabilities: outer join, ring collectives, job retries."""
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.parallel.ring import ring_allgather, ring_allreduce
+
+
+@pytest.fixture(autouse=True)
+def small_partitions():
+    old = settings.partitions
+    settings.partitions = 8
+    yield
+    settings.partitions = old
+
+
+class TestOuterJoin:
+    def test_outer_reduce(self):
+        left = Dampr.memory([("foo", 13), ("bar", 14)]).group_by(
+            lambda x: x[0])
+        right = Dampr.memory([("bar", "b"), ("baz", "z")]).group_by(
+            lambda x: x[0])
+        out = left.join(right).outer_reduce(
+            lambda lit, rit: (list(lit), list(rit))).read()
+        assert out == [
+            ("bar", ([("bar", 14)], [("bar", "b")])),
+            ("baz", ([], [("baz", "z")])),
+            ("foo", ([("foo", 13)], [])),
+        ]
+
+    def test_outer_matches_inner_plus_exclusives(self):
+        left = Dampr.memory(list(range(0, 10))).group_by(lambda x: x % 7)
+        right = Dampr.memory(list(range(5, 15))).group_by(lambda x: x % 7)
+        outer = left.join(right).outer_reduce(
+            lambda l, r: (sorted(l), sorted(r))).read()
+        # every key 0..6 appears exactly once with both sides' members
+        assert [k for k, _v in outer] == list(range(7))
+
+    def test_outer_empty_sides(self):
+        left = Dampr.memory([]).group_by(lambda x: x)
+        right = Dampr.memory([("k", 1)]).group_by(lambda x: x[0])
+        out = left.join(right).outer_reduce(
+            lambda l, r: (list(l), list(r))).read()
+        assert out == [("k", ([], [("k", 1)]))]
+
+
+class TestRingCollectives:
+    def test_ring_allreduce_matches_sum(self, mesh8):
+        x = np.arange(8 * 16, dtype=np.float32).reshape(8 * 16)
+        out = ring_allreduce(mesh8, x)
+        total = x.reshape(8, 16).sum(axis=0)
+        for d in range(8):
+            np.testing.assert_allclose(out.reshape(8, 16)[d], total,
+                                       rtol=1e-6)
+
+    def test_ring_allreduce_max(self, mesh8):
+        rng = np.random.RandomState(1)
+        x = rng.randn(8 * 32).astype(np.float32)
+        out = ring_allreduce(mesh8, x, op="max")
+        want = x.reshape(8, 32).max(axis=0)
+        for d in range(8):
+            np.testing.assert_allclose(out.reshape(8, 32)[d], want)
+
+    def test_ring_allgather(self, mesh8):
+        x = np.arange(8 * 4, dtype=np.float32)
+        out = ring_allgather(mesh8, x)
+        # every device ends with all shards concatenated in device order
+        out = out.reshape(8, 32)
+        for d in range(8):
+            np.testing.assert_allclose(out[d], x)
+
+
+class TestJobRetries:
+    def test_flaky_job_succeeds_with_retry(self):
+        attempts = {"n": 0}
+
+        def flaky(x):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise RuntimeError("transient")
+            return x * 2
+
+        old = settings.job_retries
+        settings.job_retries = 2
+        try:
+            out = Dampr.memory([1, 2, 3], partitions=1).map(flaky).read()
+            assert out == [2, 4, 6]
+        finally:
+            settings.job_retries = old
+
+    def test_persistent_failure_still_raises(self):
+        def always(x):
+            raise RuntimeError("permanent")
+
+        old = settings.job_retries
+        settings.job_retries = 1
+        try:
+            with pytest.raises(RuntimeError, match="permanent"):
+                Dampr.memory([1], partitions=1).map(always).read()
+        finally:
+            settings.job_retries = old
+
+
+class TestRetryNoLeak:
+    def test_failed_attempt_registrations_rolled_back(self):
+        from dampr_tpu.runner import MTRunner
+
+        state = {"n": 0}
+
+        def flaky_reducer(k, it):
+            vals = sum(it)
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("transient mid-reduce")
+            return vals
+
+        old = settings.job_retries
+        settings.job_retries = 1
+        try:
+            pipe = (Dampr.memory(list(range(100)), partitions=4)
+                    .group_by(lambda x: x % 3).reduce(flaky_reducer))
+            runner = MTRunner("retry-leak", pipe.pmer.graph)
+            out = runner.run([pipe.source])
+            got = dict(v for _k, v in out[0].read())
+            assert got == {i: sum(range(i, 100, 3)) for i in range(3)}
+            # no orphaned refs: residency equals live partition contents
+            live = sum(r.nbytes for r in out[0].pset.all_refs()
+                       if r.resident)
+            assert runner.store._resident_bytes <= live + 1024
+        finally:
+            settings.job_retries = old
