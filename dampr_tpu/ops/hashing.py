@@ -165,6 +165,13 @@ def _fnv(mat, lens):
     n = mat.shape[0]
     if not _use_device(n):
         return _fnv_numpy(mat, lens)
+    if settings.use_pallas:
+        import jax
+        if jax.default_backend() not in ("cpu", "gpu"):
+            # Mosaic lowering is TPU-only; other backends keep the
+            # portable _fnv_jit path below.
+            from .pallas_fnv import fnv_pallas
+            return fnv_pallas(mat, lens)
     np_rows = _pow2_rows(n)
     if np_rows != n:
         mat = np.pad(mat, ((0, np_rows - n), (0, 0)))

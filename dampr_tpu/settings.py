@@ -61,6 +61,12 @@ use_device = os.environ.get("DAMPR_TPU_USE_DEVICE", "1") not in ("0", "false")
 #: the numpy path to dodge dispatch overhead.
 device_min_batch = 4096
 
+#: Use the Pallas TPU kernel for batched string hashing (ops/pallas_fnv.py):
+#: keeps both FNV lanes VMEM-resident across the whole byte scan.  Off by
+#: default — on locally-attached TPUs it wins; through a remote-transfer
+#: tunnel the widened input upload dominates.
+use_pallas = os.environ.get("DAMPR_TPU_PALLAS", "0") in ("1", "true")
+
 #: Capacity slack factor for the fixed-shape all_to_all shuffle exchange
 #: (MoE-style capacity: per-(src,dst) buffer = ceil(N/D) * factor).
 shuffle_capacity_factor = 1.5
