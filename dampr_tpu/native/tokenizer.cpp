@@ -24,14 +24,24 @@ extern "C" {
 // Token classification modes (keep in sync with dampr_tpu/ops/text.py):
 //   mode 0: whitespace-delimited (str.split semantics, ASCII whitespace)
 //   mode 1: word characters [0-9A-Za-z_] + bytes >= 128 (re [^\w]+ on ASCII)
-static inline bool in_token(uint8_t b, int mode) {
-    if (mode == 0) {
-        return !(b == ' ' || b == '\t' || b == '\n' || b == '\r' ||
-                 b == '\v' || b == '\f');
+// Table-driven: one L1-resident lookup per byte beats the range-compare
+// chain in the hot scan.
+struct ClassTables {
+    bool tok[2][256];
+    uint8_t fold[2][256];  // [lower?][byte] -> case-folded byte
+    ClassTables() {
+        for (int b = 0; b < 256; ++b) {
+            tok[0][b] = !(b == ' ' || b == '\t' || b == '\n' || b == '\r' ||
+                          b == '\v' || b == '\f');
+            tok[1][b] = (b >= '0' && b <= '9') || (b >= 'A' && b <= 'Z') ||
+                        (b >= 'a' && b <= 'z') || b == '_' || b >= 128;
+            fold[0][b] = (uint8_t)b;
+            fold[1][b] = (b >= 'A' && b <= 'Z') ? (uint8_t)(b + 32)
+                                                : (uint8_t)b;
+        }
     }
-    return (b >= '0' && b <= '9') || (b >= 'A' && b <= 'Z') ||
-           (b >= 'a' && b <= 'z') || b == '_' || b >= 128;
-}
+};
+static const ClassTables kTables;
 
 // Single pass: tokenize + hash + (optional) lowercase folding into the hash.
 // Returns the number of tokens found.  Output arrays must hold at least
@@ -45,24 +55,25 @@ long dampr_tokenize_hash(const uint8_t* buf, long n, int mode, int lower,
     const uint32_t OFF1 = 2166136261u, OFF2 = 0x9747B28Cu;
     const uint32_t P1 = 16777619u, P2 = 0x85EBCA6Bu;
 
+    const uint8_t* fold = kTables.fold[lower ? 1 : 0];
+    const bool* tokt = kTables.tok[mode ? 1 : 0];
     long count = 0;
     long i = 0;
     int64_t line = 0;
     while (i < n) {
         uint8_t b = buf[i];
         if (b == '\n') { ++line; ++i; continue; }
-        if (!in_token(b, mode)) { ++i; continue; }
+        if (!tokt[b]) { ++i; continue; }
         // token run
         long s = i;
         uint32_t h1 = OFF1, h2 = OFF2;
         int64_t tok_line = line;
         do {
-            uint8_t c = buf[i];
-            if (lower && c >= 'A' && c <= 'Z') c += 32;
+            uint8_t c = fold[buf[i]];
             h1 = (h1 ^ c) * P1;
             h2 = (h2 ^ c) * P2;
             ++i;
-        } while (i < n && in_token(buf[i], mode));
+        } while (i < n && tokt[buf[i]]);
         starts[count] = s;
         lens[count] = (int32_t)(i - s);
         h1_out[count] = h1;
@@ -128,24 +139,25 @@ long dampr_token_counts(const uint8_t* buf, long n, int mode, int lower,
     if (!tbl) return -1;
     long used = 0;
 
+    const uint8_t* fold = kTables.fold[lower ? 1 : 0];
+    const bool* tokt = kTables.tok[mode ? 1 : 0];
     long i = 0;
     int64_t line = 0;
     while (i < n) {
         uint8_t b = buf[i];
         if (b == '\n') { ++line; ++i; continue; }
-        if (!in_token(b, mode)) { ++i; continue; }
+        if (!tokt[b]) { ++i; continue; }
         long s = i;
         uint32_t h1 = OFF1, h2 = OFF2;
         uint64_t prefix = 0;
         do {
-            uint8_t c = buf[i];
-            if (lower && c >= 'A' && c <= 'Z') c += 32;
+            uint8_t c = fold[buf[i]];
             h1 = (h1 ^ c) * P1;
             h2 = (h2 ^ c) * P2;
             long off = i - s;
             if (off < 8) prefix |= ((uint64_t)c) << (off * 8);
             ++i;
-        } while (i < n && in_token(buf[i], mode));
+        } while (i < n && tokt[buf[i]]);
         int32_t len = (int32_t)(i - s);
 
         // grow at 70% load

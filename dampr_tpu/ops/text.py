@@ -231,6 +231,45 @@ class CountRecords(Mapper):
         yield 1, count
 
 
+class ParseNumbers(Mapper):
+    """Vectorized numeric-line parser: each line holds one number; records
+    come out keyed by the parsed value (so a bare ``checkpoint()`` after this
+    mapper yields a globally sorted read — the vectorized external-sort
+    path).  ``dtype`` is int64 or float64."""
+
+    def __init__(self, dtype=np.int64):
+        self.dtype = np.dtype(dtype)
+
+    def map_blocks(self, dataset):
+        import warnings
+
+        from ..blocks import Block
+
+        data = dataset.read_bytes()
+        if not data:
+            return
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            arr = np.fromstring(data, dtype=self.dtype, sep="\n")
+        # np.fromstring stops silently at the first unparsable token; the
+        # count check turns that into the same hard error the per-record
+        # path raises, instead of silently dropping the rest of the chunk.
+        expected = len(data.split())
+        if len(arr) != expected:
+            raise ValueError(
+                "unparsable numeric line in chunk (parsed {} of {} tokens)"
+                .format(len(arr), expected))
+        yield Block(arr, arr.copy())
+
+    def map(self, *datasets):
+        assert len(datasets) == 1
+        caster = int if self.dtype.kind == "i" else float
+        for _k, line in datasets[0].read():
+            if line.strip():
+                v = caster(line)
+                yield v, v
+
+
 class TokenCounts(Mapper):
     """Vectorized word count over raw text chunks: each record downstream is
     a ``(token, count)`` tuple, pre-folded per chunk.  Chain ``.fold_by(lambda

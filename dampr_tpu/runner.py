@@ -93,16 +93,58 @@ class OutputDataset(Dataset):
             blk = blk.take(order)
         return blk.iter_pairs()
 
-    def read(self):
-        from .dataset import StreamDataset, merged_read
+    def _sorted_concat(self):
+        """Vectorized fast path: one concat + stable argsort of the whole
+        output.  Returns None when it shouldn't run — the working copies
+        (refs + concat + take) peak near 3x the output size, so it is gated
+        at a third of the memory budget; uncomparable mixed keys also bail
+        to the streamed merge."""
+        total = sum(r.nbytes for r in self.pset.all_refs())
+        if total * 3 > settings.max_memory_per_stage:
+            return None
+        blk = Block.concat([r.get() for r in self.pset.all_refs()])
+        if not len(blk):
+            return blk
+        try:
+            order = np.argsort(blk.keys, kind="stable")
+        except TypeError:
+            return None
+        return blk.take(order)
 
+    def read(self):
         pids = sorted(self.pset.parts)
         if not pids:
             return iter(())
         if len(pids) == 1:
             return self._partition_stream(pids[0])
+        blk = self._sorted_concat()
+        if blk is not None:
+            return blk.iter_pairs()
+        return self._merge_partitions(pids)
+
+    def _merge_partitions(self, pids):
+        from .dataset import StreamDataset, merged_read
+
         streams = [StreamDataset(self._partition_stream(pid)) for pid in pids]
         return merged_read(streams)
+
+    def sorted_blocks(self):
+        """Bulk access: the key-sorted output as columnar blocks (vectorized
+        when 3x the output fits the memory budget; otherwise streamed through
+        the bounded merge and re-blocked)."""
+        blk = self._sorted_concat()
+        if blk is not None:
+            if len(blk):
+                yield blk
+            return
+        builder = BlockBuilder(settings.batch_size)
+        for k, v in self._merge_partitions(sorted(self.pset.parts)):
+            out = builder.add(k, v)
+            if out is not None:
+                yield out
+        out = builder.flush()
+        if out is not None:
+            yield out
 
     def delete(self):
         self.pset.delete(self.store)

@@ -33,11 +33,17 @@ MODULES = [
 ]
 
 
+import re as _re
+
+
 def _sig(obj):
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (TypeError, ValueError):
         return "(...)"
+    # strip live object addresses from default reprs so regenerated docs
+    # are byte-stable
+    return _re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _doc(obj, indent=""):
