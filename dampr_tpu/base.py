@@ -308,6 +308,99 @@ def _group_small(records):
     return by_key
 
 
+def _hash_bundles(view):
+    """Walk a StreamingGroupedView's merged record stream yielding
+    ``(h64pair, [(key, [values])])`` per distinct hash, in hash order.  Values
+    materialize per *hash group* (not per partition) — the streaming join's
+    memory bound is the largest single join-key group."""
+    import heapq
+    import itertools
+
+    streams = [view._run_stream(ref, i) for i, ref in enumerate(view.refs)]
+    merged = heapq.merge(*streams, key=lambda r: (r[0], r[1], r[2]))
+    for h, group in itertools.groupby(merged, key=lambda r: (r[0], r[1])):
+        yield h, _group_small(group)
+
+
+def streaming_merge_join(lview, rview, reducer):
+    """Out-of-core sort-merge join over two hash-ordered streaming views —
+    the runner's over-budget path for co-partitioned joins.  Walks both
+    sides by 64-bit hash, matching real keys inside each hash (so collisions
+    join exactly); inner/left/outer semantics and ``many`` flattening come
+    from the reducer instance.  Yields the same (k, (k, v)) records the
+    Keyed* join reducers produce."""
+    left_only = isinstance(reducer, (LeftJoin, OuterJoin))
+    right_only = isinstance(reducer, OuterJoin)
+    inner_many = getattr(reducer, "many", False)
+    joiner = reducer.joiner_f
+    default = getattr(reducer, "default", lambda: iter(()))
+
+    def emit(k, result, flatten):
+        if flatten:
+            for v in result:
+                yield k, (k, v)
+        else:
+            yield k, (k, result)
+
+    def left_emit(groups):
+        if left_only:
+            for k, vals in groups:
+                for out in emit(k, joiner(k, iter(vals), default()), False):
+                    yield out
+
+    def right_emit(groups):
+        if right_only:
+            for k, vals in groups:
+                for out in emit(k, joiner(k, default(), iter(vals)), False):
+                    yield out
+
+    lgen = _hash_bundles(lview)
+    rgen = _hash_bundles(rview)
+    lcur = next(lgen, None)
+    rcur = next(rgen, None)
+    while lcur is not None and rcur is not None:
+        if lcur[0] < rcur[0]:
+            for out in left_emit(lcur[1]):
+                yield out
+            lcur = next(lgen, None)
+        elif lcur[0] > rcur[0]:
+            for out in right_emit(rcur[1]):
+                yield out
+            rcur = next(rgen, None)
+        else:
+            # Same 64-bit hash: match by real key (collision-exact).
+            rgroups = rcur[1]  # already a materialized list (_group_small)
+            matched_r = [False] * len(rgroups)
+            for k, lvals in lcur[1]:
+                hit = None
+                for j, (rk, rvals) in enumerate(rgroups):
+                    if rk == k:
+                        hit = j
+                        break
+                if hit is not None:
+                    matched_r[hit] = True
+                    result = joiner(k, iter(lvals), iter(rgroups[hit][1]))
+                    for out in emit(k, result, inner_many):
+                        yield out
+                else:
+                    for out in left_emit([(k, lvals)]):
+                        yield out
+            for j, (rk, rvals) in enumerate(rgroups):
+                if not matched_r[j]:
+                    for out in right_emit([(rk, rvals)]):
+                        yield out
+            lcur = next(lgen, None)
+            rcur = next(rgen, None)
+    while lcur is not None:
+        for out in left_emit(lcur[1]):
+            yield out
+        lcur = next(lgen, None)
+    while rcur is not None:
+        for out in right_emit(rcur[1]):
+            yield out
+        rcur = next(rgen, None)
+
+
 class GroupedView(object):
     """Key-sorted grouped view over one input's blocks within a partition.
 

@@ -477,7 +477,37 @@ class MTRunner(object):
         order_insensitive = isinstance(
             stage.reducer, (base.Reduce, base.AssocFoldReducer))
 
+        joinable = isinstance(
+            stage.reducer, (base.KeyedInnerJoin, base.KeyedLeftJoin,
+                            base.KeyedOuterJoin))
+
         def job(pid):
+            if joinable and len(entries) == 2:
+                sizes = [sum(r.nbytes for r in pset.refs(pid))
+                         for pset in entries]
+                if sum(sizes) > threshold:
+                    # Over-budget join partition: hash-ordered streaming
+                    # merge join — memory bound is the largest single
+                    # join-key group, not the partition.
+                    log.info(
+                        "partition %d join (%.1f MB) exceeds the streaming "
+                        "threshold: merging by hash order", pid,
+                        sum(sizes) / 1e6)
+                    lview = base.StreamingGroupedView(entries[0].refs(pid))
+                    rview = base.StreamingGroupedView(entries[1].refs(pid))
+                    reducer = _clone_op(stage.reducer)
+                    builder = BlockBuilder(settings.batch_size)
+                    refs_out = []
+                    for k, v in base.streaming_merge_join(lview, rview,
+                                                          reducer):
+                        blk = builder.add(k, v)
+                        if blk is not None:
+                            refs_out.append(
+                                self.store.register(blk, pin=pin))
+                    blk = builder.flush()
+                    if blk is not None:
+                        refs_out.append(self.store.register(blk, pin=pin))
+                    return pid, refs_out
             views = []
             for pset in entries:
                 refs = pset.refs(pid)
@@ -486,9 +516,9 @@ class MTRunner(object):
                         and part_bytes > threshold):
                     # Out-of-core partition: stream a k-way merge over the
                     # hash-sorted runs — one window per run resident — instead
-                    # of materializing the whole partition.  (Joins keep the
-                    # materialized key-ordered path; their walk contract is
-                    # key order on both sides.)
+                    # of materializing the whole partition.  (Over-budget
+                    # joins were handled above via the hash-ordered streaming
+                    # merge join; Stream/BlockReducers still materialize.)
                     log.info(
                         "partition %d (%.1f MB) exceeds the streaming "
                         "threshold: groups will stream in hash order",
