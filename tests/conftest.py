@@ -35,3 +35,14 @@ def mesh8():
     devs = np.array(jax.devices())
     assert devs.size == 8, devs
     return Mesh(devs, ("shards",))
+
+
+@pytest.fixture
+def partitions8():
+    """Shared: pin settings.partitions to 8 for a test, restoring after."""
+    from dampr_tpu import settings
+
+    old = settings.partitions
+    settings.partitions = 8
+    yield
+    settings.partitions = old

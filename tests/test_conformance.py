@@ -22,11 +22,8 @@ from dampr_tpu.utils import filter_by_count
 
 
 @pytest.fixture(autouse=True)
-def small_partitions():
-    old = settings.partitions
-    settings.partitions = 8
+def small_partitions(partitions8):
     yield
-    settings.partitions = old
 
 
 @pytest.fixture

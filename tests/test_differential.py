@@ -1,0 +1,187 @@
+"""Differential conformance: run the SAME pipelines through the actual
+reference implementation (subprocess, clean interpreter — its fork-based
+runner must not inherit this process's JAX threads) and through dampr_tpu,
+and compare materialized results exactly.
+
+This is the strongest parity evidence the suite has: not our reading of the
+reference's semantics, but the reference itself as the oracle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+
+REFERENCE = "/root/reference"
+
+# Each case: (name, reference_script_body, ours_fn).  Scripts print one JSON
+# line; bodies only use the shared DSL surface.  `DATA` is the shared input.
+DATA = list(range(30, 50))
+
+_REF_PRELUDE = """
+import json, sys
+sys.path.insert(0, {ref!r})
+from dampr import Dampr
+data = {data!r}
+""".format(ref=REFERENCE, data=DATA)
+
+
+def run_reference(body):
+    script = _REF_PRELUDE + body
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120,
+        env={"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+             "HOME": "/tmp"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+@pytest.fixture(autouse=True)
+def small_partitions(partitions8):
+    yield
+
+
+def norm(x):
+    """JSON round-trip normalization (tuples->lists) for comparison."""
+    return json.loads(json.dumps(x))
+
+
+class TestDifferential:
+    def test_map_filter_flat_map(self):
+        ref = run_reference("""
+out = Dampr.memory(data).map(lambda x: x + 1).filter(lambda x: x % 3 != 0) \\
+    .flat_map(lambda x: [x, -x]).read()
+print(json.dumps(out))
+""")
+        ours = (Dampr.memory(DATA).map(lambda x: x + 1)
+                .filter(lambda x: x % 3 != 0)
+                .flat_map(lambda x: [x, -x]).read())
+        assert norm(ours) == ref
+
+    def test_group_by_reduce(self):
+        ref = run_reference("""
+out = Dampr.memory(data).group_by(lambda x: x % 4) \\
+    .reduce(lambda k, it: sum(it)).read()
+print(json.dumps(out))
+""")
+        ours = (Dampr.memory(DATA).group_by(lambda x: x % 4)
+                .reduce(lambda k, it: sum(it)).read())
+        assert norm(ours) == ref
+
+    def test_fold_by_and_count(self):
+        ref = run_reference("""
+a = Dampr.memory(data).fold_by(lambda x: x % 5, lambda x, y: x + y).read()
+b = Dampr.memory(data).count(lambda x: x % 2).read()
+print(json.dumps([a, b]))
+""")
+        a = Dampr.memory(DATA).fold_by(lambda x: x % 5,
+                                       lambda x, y: x + y).read()
+        b = Dampr.memory(DATA).count(lambda x: x % 2).read()
+        assert norm([a, b]) == ref
+
+    def test_mean_len_topk(self):
+        ref = run_reference("""
+m = Dampr.memory(data).mean(lambda x: x % 3).read()
+l = Dampr.memory(data).len().read()
+t = sorted(Dampr.memory(data).topk(4).read())
+print(json.dumps([m, l, t]))
+""")
+        m = Dampr.memory(DATA).mean(lambda x: x % 3).read()
+        ln = Dampr.memory(DATA).len().read()
+        t = sorted(Dampr.memory(DATA).topk(4).read())
+        assert norm([m, ln, t]) == ref
+
+    def test_inner_and_left_join(self):
+        ref = run_reference("""
+left = Dampr.memory(data).group_by(lambda x: x % 7)
+right = Dampr.memory(list(range(40, 60))).group_by(lambda x: x % 7)
+inner = left.join(right).reduce(lambda l, r: [sorted(l), sorted(r)]).read()
+left2 = Dampr.memory(data).group_by(lambda x: x)
+right2 = Dampr.memory(list(range(45, 55))).group_by(lambda x: x)
+lj = left2.join(right2).left_reduce(lambda l, r: [sorted(l), sorted(r)]).read()
+print(json.dumps([inner, lj]))
+""")
+        left = Dampr.memory(DATA).group_by(lambda x: x % 7)
+        right = Dampr.memory(list(range(40, 60))).group_by(lambda x: x % 7)
+        inner = left.join(right).reduce(
+            lambda l, r: [sorted(l), sorted(r)]).read()
+        left2 = Dampr.memory(DATA).group_by(lambda x: x)
+        right2 = Dampr.memory(list(range(45, 55))).group_by(lambda x: x)
+        lj = left2.join(right2).left_reduce(
+            lambda l, r: [sorted(l), sorted(r)]).read()
+        assert norm([inner, lj]) == ref
+
+    def test_sort_by_and_sample_bounds(self):
+        ref = run_reference("""
+s = Dampr.memory(data).sort_by(lambda x: -x).read()
+print(json.dumps(s))
+""")
+        ours = Dampr.memory(DATA).sort_by(lambda x: -x).read()
+        assert norm(ours) == ref
+
+    def test_cross_left_and_cross_set(self):
+        ref = run_reference("""
+l = Dampr.memory(data[:4])
+r = Dampr.memory(["x", "y"])
+c = l.cross_left(r, lambda a, b: [a, b]).read()
+cs = l.cross_set(Dampr.memory([31, 33]), lambda a, s: a in s, agg=set).read()
+print(json.dumps([c, cs]))
+""")
+        l = Dampr.memory(DATA[:4])
+        r = Dampr.memory(["x", "y"])
+        c = l.cross_left(r, lambda a, b: [a, b]).read()
+        cs = l.cross_set(Dampr.memory([31, 33]), lambda a, s: a in s,
+                         agg=set).read()
+        assert norm([c, cs]) == ref
+
+    def test_multi_output_shared_prefix(self):
+        ref = run_reference("""
+evens = Dampr.memory(data).filter(lambda x: x % 2 == 0).checkpoint()
+s = evens.a_group_by(lambda x: 1).sum()
+c = evens.count(lambda x: 1)
+sv, cv = Dampr.run(s, c)
+print(json.dumps([sv.read(), cv.read()]))
+""")
+        evens = Dampr.memory(DATA).filter(lambda x: x % 2 == 0).checkpoint()
+        s = evens.a_group_by(lambda x: 1).sum()
+        c = evens.count(lambda x: 1)
+        sv, cv = Dampr.run(s, c)
+        assert norm([sv.read(), cv.read()]) == ref
+
+    def test_wordcount_text_file(self, tmp_path):
+        p = str(tmp_path / "wc.txt")
+        text = (open(os.path.join(REFERENCE, "README.md")).read()) * 2
+        with open(p, "w") as f:
+            f.write(text)
+        ref = run_reference("""
+out = sorted(Dampr.text({p!r}, 4096).flat_map(lambda l: l.split())
+             .count().read())
+print(json.dumps(out))
+""".replace("{p!r}", repr(p)))
+        ours = sorted(Dampr.text(p, 4096)
+                      .flat_map(lambda l: l.split()).count().read())
+        assert norm(ours) == ref
+
+    def test_unique_matches_as_set(self):
+        # The reference's output ORDER here is nondeterministic across runs
+        # (PYTHONHASHSEED-salted partitioning + fork completion order —
+        # verified by running it repeatedly), so compare contents only.
+        # Note: the reference's first() is NOT differentially tested — its
+        # implementation keeps the NEWEST value per key (ReducedWriter calls
+        # binop(new, cached), dataset.py:100-105), contradicting its own
+        # docstring ("first item found"); we implement the documented
+        # semantics deterministically.
+        ref = run_reference("""
+names = [("a", 1), ("a", 1), ("a", 2), ("b", 9)]
+u = Dampr.memory(names).group_by(lambda x: x[0], lambda x: x[1]).unique().read()
+print(json.dumps(sorted(u, key=str)))
+""")
+        names = [("a", 1), ("a", 1), ("a", 2), ("b", 9)]
+        u = (Dampr.memory(names)
+             .group_by(lambda x: x[0], lambda x: x[1]).unique().read())
+        assert sorted(norm(u), key=str) == ref
