@@ -50,7 +50,7 @@ def init_distributed(coordinator_address=None, num_processes=None,
     import jax
 
     if coordinator_address is None:
-        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS") or None
     if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):

@@ -493,3 +493,22 @@ class TestReferenceEdgeBehaviors:
         res = (items.group_by(lambda x: 1, lambda x: 1)
                .reduce(lambda k, it: sum(it)).run())
         assert next(iter(res))[1] == 10
+
+    def test_urls_input_file_scheme(self, tmp_path):
+        p = tmp_path / "u.txt"
+        p.write_text("line one\nline two\n")
+        out = Dampr.urls(["file://" + str(p)]).read()
+        assert [l.strip() for l in out] == ["line one", "line two"]
+
+    def test_urls_skip_on_error(self, tmp_path):
+        good = tmp_path / "g.txt"
+        good.write_text("ok\n")
+        out = Dampr.urls(["file:///nonexistent-xyz",
+                          "file://" + str(good)]).read()
+        assert [l.strip() for l in out] == ["ok"]
+
+    def test_run_n_partitions_override(self, items):
+        out = (items.group_by(lambda x: x % 2)
+               .reduce(lambda k, it: sum(it))
+               .run(n_partitions=2).read())
+        assert out == [(0, 70), (1, 75)]

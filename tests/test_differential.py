@@ -18,6 +18,10 @@ from dampr_tpu import Dampr, settings
 
 REFERENCE = "/root/reference"
 
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE),
+    reason="reference implementation not mounted at /root/reference")
+
 # Each case: (name, reference_script_body, ours_fn).  Scripts print one JSON
 # line; bodies only use the shared DSL surface.  `DATA` is the shared input.
 DATA = list(range(30, 50))
