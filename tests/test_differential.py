@@ -189,3 +189,92 @@ print(json.dumps(sorted(u, key=str)))
         u = (Dampr.memory(names)
              .group_by(lambda x: x[0], lambda x: x[1]).unique().read())
         assert sorted(norm(u), key=str) == ref
+
+    def test_custom_mapper_and_reducer(self):
+        ref = run_reference("""
+from dampr.base import Map, Reduce
+cm = Dampr.memory(data).custom_mapper(Map(lambda k, x: [(k, x * 3)])).read()
+cr = sorted(Dampr.memory(data).custom_reducer(
+    Reduce(lambda k, it: sum(it))).read())
+print(json.dumps([cm, cr]))
+""")
+        from dampr_tpu import Map, Reduce
+        cm = Dampr.memory(DATA).custom_mapper(
+            Map(lambda k, x: [(k, x * 3)])).read()
+        cr = sorted(Dampr.memory(DATA).custom_reducer(
+            Reduce(lambda k, it: sum(it))).read())
+        assert norm([cm, cr]) == ref
+
+    def test_partition_map_reduce(self):
+        ref = run_reference("""
+def pm(items):
+    total = 0
+    for v in items:
+        total += v
+    yield 1, total
+
+def pr(groups):
+    s = 0
+    seen = False
+    for _k, vals in groups:
+        for v in vals:
+            seen = True
+            s += v
+    if seen:
+        yield "sum", s
+
+out = Dampr.memory(data).partition_map(pm).partition_reduce(pr).read()
+print(json.dumps(sorted(v[1] for v in out)))
+""")
+        def pm(items):
+            total = 0
+            for v in items:
+                total += v
+            yield 1, total
+
+        def pr(groups):
+            s = 0
+            seen = False
+            for _k, vals in groups:
+                for v in vals:
+                    seen = True
+                    s += v
+            if seen:
+                yield "sum", s
+
+        out = Dampr.memory(DATA).partition_map(pm).partition_reduce(pr).read()
+        assert sorted(v[1] for v in out) == ref
+
+    def test_sink_tsv_round_trip(self, tmp_path):
+        ref_dir = str(tmp_path / "ref_sink")
+        ref = run_reference("""
+Dampr.memory([(x, x * x) for x in data]).sink_tsv({d!r}).run()
+import os
+lines = []
+for p in sorted(os.listdir({d!r})):
+    with open(os.path.join({d!r}, p)) as f:
+        lines.extend(l.strip() for l in f if l.strip())
+print(json.dumps(sorted(lines)))
+""".replace("{d!r}", repr(ref_dir)))
+        ours_dir = str(tmp_path / "ours_sink")
+        Dampr.memory([(x, x * x) for x in DATA]).sink_tsv(ours_dir).run()
+        lines = []
+        for p in sorted(os.listdir(ours_dir)):
+            with open(os.path.join(ours_dir, p)) as f:
+                lines.extend(l.strip() for l in f if l.strip())
+        assert sorted(lines) == ref
+
+    def test_filter_by_count_util(self):
+        ref = run_reference("""
+sys.path.insert(0, {ref!r})
+from dampr.utils import filter_by_count
+d2 = ["a"] * 5 + ["b"] * 2 + ["c"]
+out = sorted(filter_by_count(Dampr.memory(d2), lambda x: x,
+                             lambda c: c >= 2).read())
+print(json.dumps(out))
+""".replace("{ref!r}", repr(REFERENCE)))
+        from dampr_tpu.utils import filter_by_count
+        d2 = ["a"] * 5 + ["b"] * 2 + ["c"]
+        out = sorted(filter_by_count(Dampr.memory(d2), lambda x: x,
+                                     lambda c: c >= 2).read())
+        assert norm(out) == ref
