@@ -158,7 +158,7 @@ def _mix_int_jit():
 
 
 def _use_device(n):
-    return settings.use_device and n >= settings.device_min_batch
+    return settings.use_device_for(n)
 
 
 def _fnv(mat, lens):

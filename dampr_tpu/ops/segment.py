@@ -99,7 +99,7 @@ def _lexsort_jit():
 def hash_sort_perm(h1, h2):
     """Return the stable permutation sorting records by (h1, h2)."""
     n = len(h1)
-    if settings.use_device and n >= settings.device_min_batch:
+    if settings.use_device_for(n):
         npad = _pow2(n)
         valid = np.zeros(npad, dtype=np.uint8)
         if npad != n:
@@ -345,7 +345,7 @@ def fold_sorted(groups, op):
             # (min/max could stay bool, but a uniform int64 lane is simpler and
             # round-trips bools as 0/1 exactly like the reference's binop).
             vals = vals.astype(np.int64)
-        if (settings.use_device and n >= settings.device_min_batch
+        if (settings.use_device_for(n)
                 and _device_fold_exact(vals, op.kind)):
             # Segment ids must come from the collision-repaired group bounds,
             # not raw (h1,h2) adjacency — after a 64-bit collision the repaired

@@ -183,6 +183,7 @@ class MTRunner(object):
         self.store = storage.RunStore(name, budget=memory_budget)
         self.stats = []
         self.mesh_folds = 0  # reduces executed via the mesh collective path
+        self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
 
     # -- job fan-out --------------------------------------------------------
     def _pool_run(self, fn, jobs, n_workers):
@@ -481,6 +482,53 @@ class MTRunner(object):
             stage.reducer, (base.KeyedInnerJoin, base.KeyedLeftJoin,
                             base.KeyedOuterJoin))
 
+        def _streaming_assoc_fold(refs, reducer):
+            """Over-budget associative fold, vectorized: fold each spill
+            window as it streams and re-compact partials — the working set is
+            one accumulator of *distinct keys*, not the partition's records
+            (the reduce-side mirror of the map-side _PARTIAL_FANIN combine).
+            Returns None (caller falls back to the per-record stream) if the
+            accumulator itself outgrows the threshold (extreme cardinality).
+            """
+            op = reducer.op
+            partials = []
+
+            def compact():
+                merged = segment.fold_block(Block.concat(partials), op)
+                del partials[:]
+                partials.append(merged)
+                return merged.nbytes()
+
+            for ref in refs:
+                for window in ref.iter_windows():
+                    if not len(window):
+                        continue
+                    partials.append(segment.fold_block(window, op))
+                    if len(partials) >= _PARTIAL_FANIN:
+                        if compact() > threshold:
+                            return None
+            if not partials:
+                return iter(())
+            self.streamed_assoc_folds += 1
+            final = segment.fold_sorted(
+                segment.sort_and_group(Block.concat(partials)), op)
+            gkeys = final.keys
+            try:
+                order = np.argsort(gkeys, kind="stable")
+            except TypeError:
+                order = np.arange(len(final))
+
+            def emit():
+                vals = final.values
+                for gi in order:
+                    k = gkeys[gi]
+                    v = vals[gi]
+                    k = k.item() if isinstance(k, np.generic) else k
+                    v = v.item() if isinstance(v, np.generic) else v
+                    yield k, (k, v)
+
+            return emit()
+
         def job(pid):
             if joinable and len(entries) == 2:
                 sizes = [sum(r.nbytes for r in pset.refs(pid))
@@ -508,29 +556,44 @@ class MTRunner(object):
                     if blk is not None:
                         refs_out.append(self.store.register(blk, pin=pin))
                     return pid, refs_out
-            views = []
-            for pset in entries:
-                refs = pset.refs(pid)
-                part_bytes = sum(r.nbytes for r in refs)
-                if (len(entries) == 1 and order_insensitive
-                        and part_bytes > threshold):
-                    # Out-of-core partition: stream a k-way merge over the
-                    # hash-sorted runs — one window per run resident — instead
-                    # of materializing the whole partition.  (Over-budget
-                    # joins were handled above via the hash-ordered streaming
-                    # merge join; Stream/BlockReducers still materialize.)
-                    log.info(
-                        "partition %d (%.1f MB) exceeds the streaming "
-                        "threshold: groups will stream in hash order",
-                        pid, part_bytes / 1e6)
-                    views.append(base.StreamingGroupedView(refs))
-                else:
-                    views.append(base.GroupedView(
-                        [ref.get() for ref in refs]))
-            reducer = _clone_op(stage.reducer)
+            record_stream = None
+            if len(entries) == 1:
+                prefs = entries[0].refs(pid)
+                part_bytes = sum(r.nbytes for r in prefs)
+                if (part_bytes > threshold
+                        and isinstance(stage.reducer, base.AssocFoldReducer)
+                        and stage.reducer.op.kind is not None):
+                    record_stream = _streaming_assoc_fold(
+                        prefs, stage.reducer)
+
+            if record_stream is None:
+                views = []
+                for pset in entries:
+                    refs = pset.refs(pid)
+                    part_bytes = sum(r.nbytes for r in refs)
+                    if (len(entries) == 1 and order_insensitive
+                            and part_bytes > threshold):
+                        # Out-of-core partition: stream a k-way merge over
+                        # the hash-sorted runs — one window per run resident
+                        # — instead of materializing the whole partition.
+                        # (Over-budget joins were handled above; assoc folds
+                        # with recognized ops took the vectorized accumulator
+                        # unless cardinality blew it; Stream/BlockReducers
+                        # still materialize.)
+                        log.info(
+                            "partition %d (%.1f MB) exceeds the streaming "
+                            "threshold: groups will stream in hash order",
+                            pid, part_bytes / 1e6)
+                        views.append(base.StreamingGroupedView(refs))
+                    else:
+                        views.append(base.GroupedView(
+                            [ref.get() for ref in refs]))
+                reducer = _clone_op(stage.reducer)
+                record_stream = reducer.reduce(*views)
+
             builder = BlockBuilder(settings.batch_size)
             refs = []
-            for k, v in reducer.reduce(*views):
+            for k, v in record_stream:
                 blk = builder.add(k, v)
                 if blk is not None:
                     refs.append(self.store.register(blk, pin=pin))

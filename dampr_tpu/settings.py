@@ -57,9 +57,44 @@ mesh_axis = "shards"
 #: backend; when False everything uses the numpy host fallback (useful for debugging).
 use_device = os.environ.get("DAMPR_TPU_USE_DEVICE", "1") not in ("0", "false")
 
-#: Minimum records in a block before device dispatch is worth it; smaller blocks take
-#: the numpy path to dodge dispatch overhead.
-device_min_batch = 4096
+#: Minimum records in a block before device dispatch is worth it; smaller
+#: blocks take the numpy path to dodge dispatch overhead.  None = resolve by
+#: transport: in-process backends (cpu) dispatch cheaply at 4096; a
+#: locally-attached accelerator needs larger batches to amortize transfer;
+#: a remote-tunnel attachment (detected via the tunnel env) only pays off
+#: for multi-million-record batches.  Set an int to pin it.
+device_min_batch = (int(os.environ["DAMPR_TPU_DEVICE_MIN_BATCH"])
+                    if os.environ.get("DAMPR_TPU_DEVICE_MIN_BATCH") else None)
+
+#: Every auto-resolved threshold is at least this, so batches below it decide
+#: "host" without touching (or initializing) any JAX backend.
+_MIN_BATCH_FLOOR = 4096
+
+_resolved_min_batch = None
+
+
+def effective_device_min_batch():
+    global _resolved_min_batch
+    if device_min_batch is not None:
+        return device_min_batch
+    if _resolved_min_batch is None:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            _resolved_min_batch = 4096
+        elif os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+            _resolved_min_batch = 1 << 22
+        else:
+            _resolved_min_batch = 1 << 16
+    return _resolved_min_batch
+
+
+def use_device_for(n):
+    """Device-dispatch decision for an n-record batch.  Small batches answer
+    without resolving the backend (no accidental JAX initialization)."""
+    if not use_device or n < _MIN_BATCH_FLOOR:
+        return False
+    return n >= effective_device_min_batch()
 
 #: Use the Pallas TPU kernel for batched string hashing (ops/pallas_fnv.py):
 #: keeps both FNV lanes VMEM-resident across the whole byte scan.  Off by

@@ -21,8 +21,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Small blocks should still exercise the device path in tests.
+# Small blocks should still exercise the device path in tests: pin the
+# dispatch threshold so backend-specific auto-resolution never de-targets
+# device-branch regression tests.
 os.environ.setdefault("DAMPR_TPU_USE_DEVICE", "1")
+from dampr_tpu import settings as _settings  # noqa: E402
+
+_settings.device_min_batch = 4096
 
 import pytest  # noqa: E402
 

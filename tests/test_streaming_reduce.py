@@ -110,3 +110,25 @@ class TestEndToEnd:
                    .group_by(lambda x: x[0], lambda x: x[1])
                    .reduce(lambda k, it: sum(it)).read())
         assert out == {"hot": n, "cold": 10}
+
+    def test_over_budget_assoc_fold_uses_vectorized_accumulator(self):
+        from dampr_tpu.runner import MTRunner
+
+        old_mesh = settings.mesh_fold
+        settings.mesh_fold = "off"  # isolate the accumulator path
+        try:
+            # many chunks x modest key cardinality: per-chunk combined
+            # outputs stack up past the threshold per partition, while the
+            # distinct-key accumulator stays under it — the shape the
+            # vectorized streaming fold exists for
+            n_keys, repeats = 2000, 40  # 500 keys/partition ~ 12KB < 16KB threshold
+            pipe = (Dampr.memory(list(range(n_keys)) * repeats,
+                                 partitions=repeats)
+                    .count(lambda x: x).checkpoint())
+            runner = MTRunner("assoc-stream", pipe.pmer.graph)
+            out = runner.run([pipe.source])
+            got = dict(v for _k, v in out[0].read())
+            assert got == {i: repeats for i in range(n_keys)}
+            assert runner.streamed_assoc_folds >= 1
+        finally:
+            settings.mesh_fold = old_mesh
