@@ -79,18 +79,19 @@ class OutputDataset(Dataset):
     def _partition_stream(self, pid):
         from .dataset import OrderKey
 
-        blk = Block.concat([r.get() for r in self.pset.refs(pid)])
-        if len(blk):
-            try:
-                order = np.argsort(blk.keys, kind="stable")
-            except TypeError:
-                # Uncomparable mixed keys: stable Python sort under the
-                # total-order wrapper (rare path, matches the merge order).
-                keys = blk.keys
-                order = np.asarray(
-                    sorted(range(len(blk)), key=lambda i: OrderKey(keys[i])),
-                    dtype=np.int64)
+        try:
+            blk = self._sorted_partition_block(pid)
+        except TypeError:
+            # Uncomparable mixed keys: stable Python sort under the
+            # total-order wrapper (rare path, matches the merge order).
+            blk = Block.concat([r.get() for r in self.pset.refs(pid)])
+            keys = blk.keys
+            order = np.asarray(
+                sorted(range(len(blk)), key=lambda i: OrderKey(keys[i])),
+                dtype=np.int64)
             blk = blk.take(order)
+        if blk is None:
+            return iter(())
         return blk.iter_pairs()
 
     def _sorted_concat(self):
@@ -112,6 +113,8 @@ class OutputDataset(Dataset):
         return blk.take(order)
 
     def read(self):
+        import itertools
+
         pids = sorted(self.pset.parts)
         if not pids:
             return iter(())
@@ -120,6 +123,10 @@ class OutputDataset(Dataset):
         blk = self._sorted_concat()
         if blk is not None:
             return blk.iter_pairs()
+        blocks = self._vector_merge_blocks(pids)
+        if blocks is not None:
+            return itertools.chain.from_iterable(
+                b.iter_pairs() for b in blocks)
         return self._merge_partitions(pids)
 
     def _merge_partitions(self, pids):
@@ -128,17 +135,109 @@ class OutputDataset(Dataset):
         streams = [StreamDataset(self._partition_stream(pid)) for pid in pids]
         return merged_read(streams)
 
+    def _sorted_partition_block(self, pid):
+        blk = Block.concat([r.get() for r in self.pset.refs(pid)])
+        if not len(blk):
+            return None
+        order = np.argsort(blk.keys, kind="stable")  # TypeError -> caller
+        return blk.take(order)
+
+    def _vector_merge_blocks(self, pids, chunk=1 << 16):
+        """K-way merge of key-sorted numeric-keyed partitions, emitted as
+        blocks in bounded vectorized chunks: each round advances to the
+        smallest partition-chunk boundary key, gathers every record at or
+        below it via searchsorted, and stable-sorts only that slice —
+        replacing per-record Python heap merging.  Returns None (fall back to
+        the record merge) when any partition's keys are non-numeric."""
+        parts = []
+        for pid in pids:
+            refs = self.pset.refs(pid)
+            if any(getattr(r, "key_dtype", np.dtype(object)) == object
+                   for r in refs):
+                return None
+            blk = self._sorted_partition_block(pid)
+            if blk is not None:
+                parts.append(blk)
+        if not parts:
+            return iter(())
+
+        def slice_of(blk, a, b):
+            return Block(
+                blk.keys[a:b], blk.values[a:b],
+                None if blk.h1 is None else blk.h1[a:b],
+                None if blk.h2 is None else blk.h2[a:b])
+
+        def gen():
+            pos = [0] * len(parts)
+            n_parts = len(parts)
+            while True:
+                bound = None
+                active = False
+                for i in range(n_parts):
+                    blk = parts[i]
+                    if pos[i] >= len(blk):
+                        continue
+                    active = True
+                    edge = min(pos[i] + chunk, len(blk)) - 1
+                    k = blk.keys[edge]
+                    if bound is None or k < bound:
+                        bound = k
+                if not active:
+                    return
+                # Records strictly below the bound: at most `chunk` per
+                # partition by construction, so this gather is bounded —
+                # stable sort keeps partition-order ties like the heap merge.
+                pieces = []
+                for i in range(n_parts):
+                    blk = parts[i]
+                    if pos[i] >= len(blk):
+                        continue
+                    end = int(np.searchsorted(blk.keys, bound, side="left"))
+                    if end > pos[i]:
+                        pieces.append(slice_of(blk, pos[i], end))
+                        pos[i] = end
+                if pieces:
+                    merged = Block.concat(pieces)
+                    yield merged.take(
+                        np.argsort(merged.keys, kind="stable"))
+                # Records equal to the bound need no sorting: emit them as
+                # raw partition-order slices in bounded pieces, so a hot key
+                # with millions of duplicates streams instead of
+                # materializing (the heap merge's tie order is partition
+                # order, preserved here).
+                for i in range(n_parts):
+                    blk = parts[i]
+                    if pos[i] >= len(blk):
+                        continue
+                    end = int(np.searchsorted(blk.keys, bound, side="right"))
+                    at = pos[i]
+                    while at < end:
+                        sub = min(at + chunk, end)
+                        yield slice_of(blk, at, sub)
+                        at = sub
+                    pos[i] = end
+
+        return gen()
+
     def sorted_blocks(self):
-        """Bulk access: the key-sorted output as columnar blocks (vectorized
-        when 3x the output fits the memory budget; otherwise streamed through
-        the bounded merge and re-blocked)."""
+        """Bulk access: the key-sorted output as columnar blocks.  Under a
+        third of the memory budget: one concatenated sorted block.  Numeric
+        keys over budget: the vectorized k-way merge (block sizes bounded by
+        ~chunk x partitions, not settings.batch_size).  Otherwise: the
+        per-record merge re-blocked at batch_size."""
         blk = self._sorted_concat()
         if blk is not None:
             if len(blk):
                 yield blk
             return
+        pids = sorted(self.pset.parts)
+        blocks = self._vector_merge_blocks(pids)
+        if blocks is not None:
+            for b in blocks:
+                yield b
+            return
         builder = BlockBuilder(settings.batch_size)
-        for k, v in self._merge_partitions(sorted(self.pset.parts)):
+        for k, v in self._merge_partitions(pids):
             out = builder.add(k, v)
             if out is not None:
                 yield out

@@ -30,7 +30,7 @@ class BlockRef(object):
     """A handle to one materialized block: RAM-resident or spilled to disk."""
 
     __slots__ = ("_block", "path", "nbytes", "nrecords", "value_dtype",
-                 "store", "pin")
+                 "key_dtype", "store", "pin")
 
     def __init__(self, block, store=None, pin=False):
         self._block = block
@@ -38,6 +38,7 @@ class BlockRef(object):
         self.nbytes = block.nbytes()
         self.nrecords = len(block)
         self.value_dtype = block.values.dtype  # metadata survives spilling
+        self.key_dtype = block.keys.dtype
         self.store = store
         self.pin = pin
 

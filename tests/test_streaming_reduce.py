@@ -132,3 +132,67 @@ class TestEndToEnd:
             assert runner.streamed_assoc_folds >= 1
         finally:
             settings.mesh_fold = old_mesh
+
+
+class TestVectorMerge:
+    def test_matches_record_merge_exactly(self):
+        from dampr_tpu.runner import MTRunner, OutputDataset
+        settings.streaming_reduce_threshold = None
+        settings.max_memory_per_stage = 1  # force the merge paths
+        rng = np.random.RandomState(3)
+        data = rng.randint(0, 500, size=20000).tolist()
+        pipe = (Dampr.memory([(k, i) for i, k in enumerate(data)],
+                             partitions=8)
+                .map_keys(lambda k: k).checkpoint(True))
+        runner = MTRunner("vmerge", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        ds = out[0]
+        vec = list(ds.read())
+        rec = list(ds._merge_partitions(sorted(ds.pset.parts)))
+        assert vec == rec
+        keys = [k for k, _v in vec]
+        assert keys == sorted(keys)
+
+    def test_sorted_blocks_vector_path(self):
+        from dampr_tpu.runner import MTRunner
+        settings.max_memory_per_stage = 1
+        n = 30000
+        pipe = (Dampr.memory(list(range(n, 0, -1)), partitions=8)
+                .checkpoint(True))
+        runner = MTRunner("vmerge2", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        got = []
+        prev = None
+        for blk in out[0].sorted_blocks():
+            ks = blk.keys
+            assert (np.diff(ks) >= 0).all()
+            if prev is not None and len(ks):
+                assert ks[0] >= prev
+            if len(ks):
+                prev = ks[-1]
+            got.extend(blk.values.tolist())
+        assert len(got) == n
+
+    def test_object_keys_fall_back(self):
+        from dampr_tpu.runner import MTRunner
+        settings.max_memory_per_stage = 1
+        pipe = (Dampr.memory(["b", "a", "c"] * 100, partitions=4)
+                .checkpoint(True))
+        runner = MTRunner("vmerge3", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        vals = [v for _k, v in out[0].read()]
+        assert sorted(vals) == sorted(["b", "a", "c"] * 100)
+
+    def test_hot_key_duplicates_stream_bounded(self):
+        from dampr_tpu.runner import MTRunner
+        settings.max_memory_per_stage = 1
+        # one dominant key with many duplicates across partitions
+        data = [(7, i) for i in range(50000)] + [(j, -j) for j in range(50)]
+        pipe = Dampr.memory(data, partitions=8).checkpoint(True)
+        runner = MTRunner("vmerge-hot", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        vec = list(out[0].read())
+        rec = list(out[0]._merge_partitions(sorted(out[0].pset.parts)))
+        assert vec == rec
+        max_block = max((len(b) for b in out[0].sorted_blocks()), default=0)
+        assert max_block <= (1 << 16) * 9  # bounded, never whole-output
