@@ -284,6 +284,7 @@ _NP_FOLD = {
 }
 
 _I32_MAX = 2 ** 31 - 1
+_I64_MAX = 2 ** 63 - 1
 
 
 def _device_fold_exact(vals, kind):
@@ -298,8 +299,12 @@ def _device_fold_exact(vals, kind):
     """
     import jax
 
+    if vals.dtype == object:
+        return False  # promoted-to-object exact host fold (huge uint64 sums)
     if jax.config.jax_enable_x64:
         return True
+    if vals.dtype == np.uint64:
+        return False  # 32-bit lanes truncate; host uint64 min/max is exact
     if vals.dtype == np.int64:
         if not len(vals):
             return True
@@ -344,6 +349,25 @@ def fold_sorted(groups, op):
             # Python semantics: True + True == 2; promote before folding
             # (min/max could stay bool, but a uniform int64 lane is simpler and
             # round-trips bools as 0/1 exactly like the reference's binop).
+            vals = vals.astype(np.int64)
+        elif vals.dtype == np.uint64 and op.kind == "sum":
+            # uint64 sums wrap silently in numpy's host reduceat; when even
+            # the conservative whole-array bound (n * max) fits int64 the
+            # checked int64 path is exact, otherwise fold as Python ints.
+            # min/max stay native uint64 — reduceat compares exactly there,
+            # and _device_fold_exact keeps uint64 off the 32-bit lanes.
+            if not len(vals) or len(vals) * int(vals.max()) <= _I64_MAX:
+                vals = vals.astype(np.int64)
+            else:
+                ov = np.empty(len(vals), dtype=object)
+                ov[:] = [int(x) for x in vals]
+                vals = ov
+        elif (op.kind == "sum" and vals.dtype.kind in "iu"
+                and vals.dtype.itemsize < 8):
+            # Narrow int sums wrap silently in both reduceat and the 32-bit
+            # device lanes; the reference folds in arbitrary-precision Python
+            # ints, so promote to int64 (then the int64 exactness check below
+            # governs device eligibility as usual).
             vals = vals.astype(np.int64)
         if (settings.use_device_for(n)
                 and _device_fold_exact(vals, op.kind)):

@@ -169,6 +169,7 @@ def _native_counts_block(data, mode, lower, dedup_per_line):
     n = len(h1)
     keys = np.empty(n, dtype=object)
     vals = np.empty(n, dtype=object)
+    lossy = []
     for i in range(n):
         s = rep_start[i]
         raw = data[s:s + rep_len[i]]
@@ -177,6 +178,21 @@ def _native_counts_block(data, mode, lower, dedup_per_line):
         tok = raw.decode("utf-8", "replace")
         keys[i] = tok
         vals[i] = (tok, int(counts[i]))
+        if "�" in tok:
+            lossy.append(i)
+    if lossy:
+        # The native pass hashed the *raw* bytes, but a lossy decode means the
+        # materialized key is the U+FFFD-substituted string — recompute those
+        # lanes from the key so the engine invariant (cached lanes ==
+        # hash_keys(key), relied on by partition routing and sorted-run
+        # merging) holds for every record.  A token that legitimately contains
+        # U+FFFD re-encodes to the same bytes, so recomputing is a no-op.
+        idx = np.asarray(lossy, dtype=np.int64)
+        rh1, rh2 = hashing.hash_keys(keys.take(idx))
+        h1 = np.array(h1, dtype=np.uint32, copy=True)
+        h2 = np.array(h2, dtype=np.uint32, copy=True)
+        h1[idx] = rh1
+        h2[idx] = rh2
     return Block(keys, vals, h1, h2)
 
 
@@ -192,9 +208,20 @@ def chunk_doc_freq(data, mode="word", lower=True):
     """bytes -> Block of (token, n_lines_containing) — per-line dedup then
     count, i.e. ``flat_map(lambda line: set(tokenize(line))).count()``."""
     blk = _native_counts_block(data, mode, lower, dedup_per_line=1)
-    if blk is not None:
-        return blk
-    return _numpy_counts_block(data, mode, lower, dedup_per_line=1)
+    if blk is None:
+        blk = _numpy_counts_block(data, mode, lower, dedup_per_line=1)
+    if any(isinstance(k, str) and "�" in k for k in blk.keys):
+        # Lossy decode breaks the per-line *set* contract: distinct invalid
+        # byte tokens on one line all materialize as the same U+FFFD string,
+        # but byte-level dedup counted them separately.  Re-run on the
+        # round-trip-clean re-encoding, where byte dedup == string dedup.
+        # (A legitimate U+FFFD round-trips, so this re-run is idempotent.)
+        clean = data.decode("utf-8", "replace").encode("utf-8")
+        if clean != data:
+            blk = _native_counts_block(clean, mode, lower, dedup_per_line=1)
+            if blk is None:
+                blk = _numpy_counts_block(clean, mode, lower, dedup_per_line=1)
+    return blk
 
 
 class CountRecords(Mapper):
@@ -241,24 +268,17 @@ class ParseNumbers(Mapper):
         self.dtype = np.dtype(dtype)
 
     def map_blocks(self, dataset):
-        import warnings
-
         from ..blocks import Block
 
         data = dataset.read_bytes()
         if not data:
             return
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore", DeprecationWarning)
-            arr = np.fromstring(data, dtype=self.dtype, sep="\n")
-        # np.fromstring stops silently at the first unparsable token; the
-        # count check turns that into the same hard error the per-record
-        # path raises, instead of silently dropping the rest of the chunk.
-        expected = len(data.split())
-        if len(arr) != expected:
-            raise ValueError(
-                "unparsable numeric line in chunk (parsed {} of {} tokens)"
-                .format(len(arr), expected))
+        toks = data.split()
+        if not toks:
+            return
+        # np.array parses each token in C and raises on the first unparsable
+        # one — the same hard error the per-record path gives.
+        arr = np.array(toks, dtype=self.dtype)
         yield Block(arr, arr.copy())
 
     def map(self, *datasets):

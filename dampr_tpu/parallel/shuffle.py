@@ -186,8 +186,17 @@ def _lane_safe_values(v, kind):
     falls back to exact host folds — ops/segment.py _device_fold_exact)."""
     import jax
 
-    if jax.config.jax_enable_x64 or v.dtype in (np.int32, np.float32):
+    if jax.config.jax_enable_x64 or v.dtype == np.float32:
         return v
+    if v.dtype == np.int32:
+        # int32 sums accumulate in the same 32-bit lanes and wrap just like
+        # out-of-range int64s would; apply the identical abs-sum bound.
+        if (kind != "sum" or not len(v)
+                or int(np.abs(v.astype(np.int64)).sum()) <= _I32_MAX):
+            return v
+        raise ValueError(
+            "int32 value sum exceeds the 32-bit device fold lanes; "
+            "enable jax_enable_x64 or pre-scale")
     if v.dtype == np.int64:
         if not len(v):
             return v.astype(np.int32)

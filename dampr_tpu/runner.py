@@ -101,7 +101,9 @@ class OutputDataset(Dataset):
         at a third of the memory budget; uncomparable mixed keys also bail
         to the streamed merge."""
         total = sum(r.nbytes for r in self.pset.all_refs())
-        if total * 3 > settings.max_memory_per_stage:
+        budget = (self.store.budget if self.store is not None
+                  else settings.max_memory_per_stage)
+        if total * 3 > budget:
             return None
         blk = Block.concat([r.get() for r in self.pset.all_refs()])
         if not len(blk):
@@ -476,7 +478,7 @@ class MTRunner(object):
         # Cheap metadata checks before touching any (possibly spilled) data.
         if any(getattr(r, "value_dtype", object) == object for r in refs):
             return None
-        if sum(r.nbytes for r in refs) > settings.max_memory_per_stage:
+        if sum(r.nbytes for r in refs) > self.store.budget:
             return None
         # Load incrementally, verifying 32-bit lane exactness per block (the
         # abs-sum bound accumulates across blocks so per-group sums stay
@@ -568,7 +570,7 @@ class MTRunner(object):
 
         threshold = settings.streaming_reduce_threshold
         if threshold is None:
-            threshold = settings.max_memory_per_stage
+            threshold = self.store.budget
         # The streaming merge yields groups in hash order, not key order —
         # safe for per-group reducers (Reduce/KeyedReduce/AssocFoldReducer,
         # where each group is independent), but Stream/BlockReducers observe

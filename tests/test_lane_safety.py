@@ -45,7 +45,72 @@ class TestSegmentFoldLanes:
             "k": 2 ** 40 + n - 1}
 
 
+class TestInt32Columns:
+    """int32 *columns* (e.g. custom map_blocks mappers) must sum exactly —
+    narrow lanes promote to int64 before any fold (ADVICE r2)."""
+
+    def test_int32_column_sum_promotes(self):
+        n = 5000
+        keys = np.zeros(n, dtype=np.int64)
+        vals = np.full(n, 10 ** 6, dtype=np.int32)  # sum 5e9 wraps in int32
+        blk = Block(keys, vals)
+        out = dict(segment.fold_block(blk, segment.SUM).iter_pairs())
+        assert out == {0: n * 10 ** 6}
+
+    def test_uint16_column_sum_promotes(self):
+        n = 4096
+        blk = Block(np.zeros(n, dtype=np.int64),
+                    np.full(n, 60000, dtype=np.uint16))
+        out = dict(segment.fold_block(blk, segment.SUM).iter_pairs())
+        assert out == {0: n * 60000}
+
+    def test_uint64_column_folds_exact(self):
+        n = 4096
+        blk = Block(np.zeros(n, dtype=np.int64),
+                    np.full(n, 2 ** 40, dtype=np.uint64))
+        assert dict(segment.fold_block(blk, segment.SUM).iter_pairs()) == {
+            0: n * 2 ** 40}
+        assert dict(segment.fold_block(blk, segment.MAX).iter_pairs()) == {
+            0: 2 ** 40}
+        assert dict(segment.fold_block(blk, segment.MIN).iter_pairs()) == {
+            0: 2 ** 40}
+
+    def test_uint64_beyond_int64_exact(self):
+        big = 2 ** 63 + 5
+        blk = Block(np.zeros(3, dtype=np.int64),
+                    np.array([big, big, big], dtype=np.uint64))
+        assert dict(segment.fold_block(blk, segment.SUM).iter_pairs()) == {
+            0: 3 * big}
+        assert dict(segment.fold_block(blk, segment.MAX).iter_pairs()) == {
+            0: big}
+
+    def test_uint64_aggregate_overflow_exact(self):
+        # per-element fits int64 but the sum exceeds it: must not wrap
+        blk = Block(np.zeros(4, dtype=np.int64),
+                    np.full(4, 2 ** 62, dtype=np.uint64))
+        assert dict(segment.fold_block(blk, segment.SUM).iter_pairs()) == {
+            0: 4 * 2 ** 62}
+
+    def test_int32_minmax_stay_narrow(self):
+        blk = Block(np.zeros(4, dtype=np.int64),
+                    np.array([3, -7, 5, 1], dtype=np.int32))
+        assert dict(segment.fold_block(blk, segment.MIN).iter_pairs()) == {0: -7}
+        assert dict(segment.fold_block(blk, segment.MAX).iter_pairs()) == {0: 5}
+
+
 class TestMeshLanes:
+    def test_keyed_fold_int32_overflow_raises(self, mesh8):
+        h1, h2 = hashing.hash_keys(np.array([1] * 10))
+        with pytest.raises(ValueError, match="32-bit"):
+            mesh_keyed_fold(mesh8, h1, h2,
+                            np.full(10, 2 ** 30, dtype=np.int32), "sum")
+
+    def test_keyed_fold_int32_in_range_ok(self, mesh8):
+        h1, h2 = hashing.hash_keys(np.array([1] * 10))
+        fh1, fh2, fv = mesh_keyed_fold(
+            mesh8, h1, h2, np.full(10, 7, dtype=np.int32), "sum")
+        assert fv.tolist() == [70]
+
     def test_keyed_fold_large_int_raises(self, mesh8):
         h1, h2 = hashing.hash_keys(np.array([1] * 10))
         with pytest.raises(ValueError, match="32-bit"):

@@ -163,6 +163,49 @@ class TestReviewRegressions:
         kh1, _ = hashing.hash_keys(blk.keys)
         np.testing.assert_array_equal(blk.h1, kh1)
 
+    def test_non_utf8_tokens_lanes_match_keys(self):
+        # Invalid UTF-8 bytes decode lossily to U+FFFD strings; the cached
+        # hash lanes must equal hash_keys(materialized key) in both the
+        # native and numpy paths (ADVICE r2 medium finding).
+        from dampr_tpu.ops import hashing
+        data = b"abc \xff\xfe def\nabc \xff\xfe again\n"
+        for fn in (T.chunk_token_counts, T.chunk_doc_freq):
+            blk = fn(data)
+            assert len(blk)
+            kh1, kh2 = hashing.hash_keys(blk.keys)
+            np.testing.assert_array_equal(np.asarray(blk.h1), kh1)
+            np.testing.assert_array_equal(np.asarray(blk.h2), kh2)
+
+    def test_doc_freq_lossy_tokens_dedup_per_line(self):
+        # Two distinct invalid byte tokens on one line decode to the same
+        # U+FFFD string; the per-line *set* contract counts that line once.
+        data = b"abc \xff \xfe xyz\n"
+        got = {k: v[1] for k, v in T.chunk_doc_freq(data).iter_pairs()}
+        assert got["�"] == 1
+        assert got["abc"] == 1 and got["xyz"] == 1
+        # and across lines it still counts per line
+        got2 = {k: v[1]
+                for k, v in T.chunk_doc_freq(data * 3).iter_pairs()}
+        assert got2["�"] == 3
+
+    def test_parse_numbers_no_fromstring(self, tmp_path):
+        class _Bytes:
+            def __init__(self, data):
+                self._data = data
+
+            def read_bytes(self):
+                return self._data
+
+        p = T.ParseNumbers()
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any DeprecationWarning fails
+            blocks = list(p.map_blocks(_Bytes(b"3\n1\n2\n")))
+        got = sorted(v for _k, v in blocks[0].iter_pairs())
+        assert got == [1, 2, 3]
+        with pytest.raises(ValueError):
+            list(p.map_blocks(_Bytes(b"1\nnope\n")))
+
     def test_gzip_len_streams(self, tmp_path):
         import gzip as gz
         p = str(tmp_path / "z.gz")
