@@ -18,8 +18,10 @@ a v4-8 slice, or multi-host DCN — only the Mesh changes (SURVEY §7 hard
 part 5).
 """
 
+from .exchange import mesh_blob_exchange, mesh_shuffle_blocks
 from .mesh import data_mesh, default_mesh, init_distributed
 from .shuffle import mesh_global_sum, mesh_keyed_fold
 
 __all__ = ["data_mesh", "default_mesh", "init_distributed",
-           "mesh_keyed_fold", "mesh_global_sum"]
+           "mesh_keyed_fold", "mesh_global_sum",
+           "mesh_blob_exchange", "mesh_shuffle_blocks"]

@@ -170,50 +170,56 @@ def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
     return jax.jit(program)
 
 
-def _pad_pow2(n):
-    return max(8, 1 << max(0, (n - 1).bit_length()))
+def _pad_pow2(n, floor=8):
+    return max(floor, 1 << max(0, (n - 1).bit_length()))
 
 
 _I32_MAX = 2 ** 31 - 1
+_I64_MAX = 2 ** 63 - 1
 
 
 def _lane_safe_values(v, kind):
     """Make values exact in the device lanes, or refuse loudly.
 
     With jax_enable_x64 off the mesh program runs 32-bit lanes; silent
-    truncation of int64/float64 would corrupt folds, so out-of-range inputs
-    raise with guidance instead (same contract as the single-chip path, which
-    falls back to exact host folds — ops/segment.py _device_fold_exact)."""
+    truncation would corrupt folds, so every dtype is whitelisted: floats
+    ride as float32 (float64 refuses — precision), every integer dtype
+    (signed, unsigned, any width) exact-casts into the checked int32 lane or
+    refuses (same contract as the single-chip path, which falls back to
+    exact host folds — ops/segment.py _device_fold_exact)."""
     import jax
 
-    if jax.config.jax_enable_x64 or v.dtype == np.float32:
+    if v.dtype == object:
+        raise ValueError("object values cannot ride the mesh fold lanes")
+    if jax.config.jax_enable_x64:
         return v
-    if v.dtype == np.int32:
-        # int32 sums accumulate in the same 32-bit lanes and wrap just like
-        # out-of-range int64s would; apply the identical abs-sum bound.
-        if (kind != "sum" or not len(v)
-                or int(np.abs(v.astype(np.int64)).sum()) <= _I32_MAX):
-            return v
-        raise ValueError(
-            "int32 value sum exceeds the 32-bit device fold lanes; "
-            "enable jax_enable_x64 or pre-scale")
-    if v.dtype == np.int64:
-        if not len(v):
-            return v.astype(np.int32)
-        lo, hi = int(v.min()), int(v.max())
-        in_range = lo >= -_I32_MAX - 1 and hi <= _I32_MAX
-        if in_range and (kind != "sum"
-                         or int(np.abs(v).sum()) <= _I32_MAX):
-            return v.astype(np.int32)
-        raise ValueError(
-            "int64 values exceed the 32-bit device fold lanes "
-            "(min={}, max={}); enable jax_enable_x64 or pre-scale".format(
-                lo, hi))
+    if v.dtype == np.float32:
+        return v
+    if v.dtype == np.float16:
+        return v.astype(np.float32)  # exact widening
     if v.dtype == np.float64:
         raise ValueError(
             "float64 values would silently fold at float32 precision on "
             "device; pass float32 explicitly or enable jax_enable_x64")
-    return v
+    if v.dtype == np.bool_ or v.dtype.kind in "iu":
+        if v.dtype == np.uint64 and len(v) and int(v.max()) > _I64_MAX:
+            raise ValueError(
+                "uint64 values exceed the device fold lanes; "
+                "enable jax_enable_x64 or pre-scale")
+        v64 = v.astype(np.int64)
+        if not len(v64):
+            return v64.astype(np.int32)
+        lo, hi = int(v64.min()), int(v64.max())
+        in_range = lo >= -_I32_MAX - 1 and hi <= _I32_MAX
+        if in_range and (kind != "sum"
+                         or int(np.abs(v64).sum()) <= _I32_MAX):
+            return v64.astype(np.int32)
+        raise ValueError(
+            "integer values exceed the 32-bit device fold lanes "
+            "(min={}, max={}); enable jax_enable_x64 or pre-scale".format(
+                lo, hi))
+    raise ValueError(
+        "unsupported value dtype {} for mesh folds".format(v.dtype))
 
 
 def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):

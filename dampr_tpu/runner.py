@@ -284,6 +284,8 @@ class MTRunner(object):
         self.store = storage.RunStore(name, budget=memory_budget)
         self.stats = []
         self.mesh_folds = 0  # reduces executed via the mesh collective path
+        self.mesh_exchanges = 0  # general shuffles routed over all_to_all
+        self.mesh_exchange_bytes = 0  # payload bytes that crossed the mesh
         self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
 
     # -- job fan-out --------------------------------------------------------
@@ -556,6 +558,62 @@ class MTRunner(object):
                  nrec, len(jax.devices()))
         return pset, nrec, 1
 
+    def _mesh_exchange_entries(self, entries):
+        """The general shuffle on the mesh (the reference's universal
+        DefaultShuffler — base.py:416-433 — as a collective): every input
+        partition's blocks cross a fixed-shape ``all_to_all`` byte exchange,
+        streamed in windows bounded by the run budget, with partition pid
+        landing on device pid % D.  Joins stay co-partitioned because both
+        inputs route identically.  Returns the exchanged PartitionSets (new
+        refs registered against the store), or None when the mesh path is
+        disabled or only one device is visible."""
+        mode = str(settings.mesh_exchange).lower()
+        if mode in ("off", "0", "false") or not settings.use_device:
+            return None
+        import jax
+
+        if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+            return None
+        from .parallel import exchange as px
+        from .parallel.mesh import data_mesh, mesh_size
+
+        mesh = data_mesh()
+        D = mesh_size(mesh)
+        # Worst-case skew sends a whole window to one (src, dst) pair, and
+        # the send buffer is D*D rows of that blob's pow2 bucket — bound the
+        # window so the buffer stays a fraction of the budget.
+        window = max(1 << 18, self.store.budget // (8 * D * D))
+
+        out_entries = []
+        for pset in entries:
+            out = storage.PartitionSet(pset.n_partitions)
+            batch, batch_bytes = [], 0
+            seq = 0
+
+            def flush():
+                nonlocal batch, batch_bytes
+                if not batch:
+                    return
+                routed = [(s, s % D, pid, ref.get())
+                          for s, pid, ref in batch]
+                received, moved = px.mesh_shuffle_blocks(mesh, routed)
+                for pid, blk in received:
+                    out.add(pid, self.store.register(blk))
+                self.mesh_exchange_bytes += moved
+                batch, batch_bytes = [], 0
+
+            for pid in sorted(pset.parts):
+                for ref in pset.parts[pid]:
+                    batch.append((seq, pid, ref))
+                    seq += 1
+                    batch_bytes += ref.nbytes
+                    if batch_bytes >= window:
+                        flush()
+            flush()
+            out_entries.append(out)
+        self.mesh_exchanges += 1
+        return out_entries
+
     def run_reduce(self, stage_id, stage, env):
         entries = [env[s] for s in stage.inputs]
         for e in entries:
@@ -565,6 +623,9 @@ class MTRunner(object):
         fast = self._mesh_reduce(stage, entries)
         if fast is not None:
             return fast
+        exchanged = self._mesh_exchange_entries(entries)
+        if exchanged is not None:
+            entries = exchanged
         P = self.n_partitions
         pin = bool(stage.options.get("memory"))
 
@@ -704,7 +765,16 @@ class MTRunner(object):
             return pid, refs
 
         n_reducers = stage.options.get("n_reducers", self.n_reducers)
-        results = self._pool_run(job, list(range(P)), n_reducers)
+        try:
+            results = self._pool_run(job, list(range(P)), n_reducers)
+        finally:
+            if exchanged is not None:
+                # The exchanged copies are intermediates private to this
+                # reduce; the originals in env still own the stage output
+                # lifecycle.  finally: a reducer exception must not leak a
+                # duplicate of the stage input against the budget.
+                for e in exchanged:
+                    e.delete(self.store)
 
         pset = storage.PartitionSet(P)
         nrec = 0

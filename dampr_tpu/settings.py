@@ -113,6 +113,15 @@ shuffle_capacity_factor = 1.5
 #: (object values, 32-bit lane overflow, 64-bit key collisions).
 mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
 
+#: Route the *general* shuffle — non-associative group_by reduces, joins —
+#: through the mesh byte exchange (parallel/exchange.py): every input
+#: partition's blocks cross a fixed-shape all_to_all, windowed under the run
+#: budget, with partition pid resident on device pid % D (co-partitioning
+#: preserved for joins by construction).  "auto" = when more than one device
+#: is visible, "on", "off".  The associative-numeric fast path (mesh_fold)
+#: takes precedence where it applies.
+mesh_exchange = os.environ.get("DAMPR_TPU_MESH_EXCHANGE", "auto")
+
 #: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")

@@ -111,6 +111,24 @@ class TestMeshLanes:
             mesh8, h1, h2, np.full(10, 7, dtype=np.int32), "sum")
         assert fv.tolist() == [70]
 
+    def test_keyed_fold_uint64_overflow_raises(self, mesh8):
+        h1, h2 = hashing.hash_keys(np.array([1] * 8))
+        with pytest.raises(ValueError, match="lanes"):
+            mesh_keyed_fold(mesh8, h1, h2,
+                            np.full(8, 2 ** 40, dtype=np.uint64), "sum")
+        with pytest.raises(ValueError, match="lanes"):
+            mesh_keyed_fold(mesh8, h1, h2,
+                            np.full(8, 2 ** 40, dtype=np.uint64), "max")
+
+    def test_keyed_fold_uint32_and_uint16_exact(self, mesh8):
+        h1, h2 = hashing.hash_keys(np.array([1] * 8))
+        _, _, fv = mesh_keyed_fold(
+            mesh8, h1, h2, np.full(8, 60000, dtype=np.uint16), "sum")
+        assert fv.tolist() == [480000]
+        with pytest.raises(ValueError, match="lanes"):
+            mesh_keyed_fold(mesh8, h1, h2,
+                            np.full(8, 2 ** 30, dtype=np.uint32), "sum")
+
     def test_keyed_fold_large_int_raises(self, mesh8):
         h1, h2 = hashing.hash_keys(np.array([1] * 10))
         with pytest.raises(ValueError, match="32-bit"):

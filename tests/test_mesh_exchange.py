@@ -1,0 +1,142 @@
+"""The general shuffle on the mesh: non-associative group_by reduces and
+joins route their exchange through the all_to_all byte collective
+(parallel/exchange.py), matching the host path exactly."""
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.runner import MTRunner
+
+
+@pytest.fixture(autouse=True)
+def exchange_on():
+    old = (settings.partitions, settings.mesh_fold, settings.mesh_exchange)
+    settings.partitions = 8
+    settings.mesh_fold = "off"  # keep the assoc fast path out of the way
+    settings.mesh_exchange = "auto"
+    yield
+    (settings.partitions, settings.mesh_fold,
+     settings.mesh_exchange) = old
+
+
+def _run(pipe, **kw):
+    runner = MTRunner("mesh-exchange-test", pipe.pmer.graph, **kw)
+    out = runner.run([pipe.source])
+    return out[0], runner
+
+
+class TestBlobExchange:
+    def test_blob_routing(self, mesh8):
+        from dampr_tpu.parallel import mesh_blob_exchange
+
+        blobs = {(s, d): bytes([s * 16 + d]) * (s + d + 1)
+                 for s in range(8) for d in range(8) if (s + d) % 3 == 0}
+        out = mesh_blob_exchange(mesh8, blobs)
+        assert out == blobs  # delivered intact, keyed by the same (src, dst)
+
+    def test_empty_and_large_blob(self, mesh8):
+        from dampr_tpu.parallel import mesh_blob_exchange
+
+        big = bytes(range(256)) * 2000  # 512000 bytes, forces a new bucket
+        out = mesh_blob_exchange(mesh8, {(0, 7): big, (3, 3): b"x"})
+        assert out[(0, 7)] == big
+        assert out[(3, 3)] == b"x"
+
+    def test_shuffle_blocks_order_and_destination(self, mesh8):
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.parallel import mesh_shuffle_blocks
+
+        routed = []
+        seq = 0
+        for pid in (0, 3, 11, 3, 8):
+            blk = Block.from_pairs([(pid, seq)])
+            routed.append((seq, seq % 8, pid, blk))
+            seq += 1
+        received, moved = mesh_shuffle_blocks(mesh8, routed)
+        assert moved > 0
+        assert [pid for pid, _ in received] == [0, 3, 11, 3, 8]  # seq order
+        assert [list(b.iter_pairs())[0][1] for _, b in received] == [
+            0, 1, 2, 3, 4]
+
+
+class TestEngineExchange:
+    def test_nonassoc_group_by_rides_exchange(self):
+        data = list(range(4000))
+        pipe = (Dampr.memory(data, partitions=8)
+                .group_by(lambda x: x % 9)
+                .reduce(lambda k, vs: sorted(vs)[:2]))
+        ds, runner = _run(pipe)
+        assert runner.mesh_exchanges >= 1
+        assert runner.mesh_exchange_bytes > 0
+        got = dict(v for v in ds.read())
+        want = {k: (k, sorted(x for x in data if x % 9 == k)[:2])
+                for k in range(9)}
+        assert got == want
+
+    def test_group_by_matches_host_path(self):
+        data = [(i % 11, i * 3) for i in range(3000)]
+
+        def build():
+            return (Dampr.memory(data, partitions=8)
+                    .group_by(lambda x: x[0])
+                    .reduce(lambda k, vs: sum(v[1] for v in vs)))
+
+        mesh_out, runner = _run(build())
+        assert runner.mesh_exchanges >= 1
+        settings.mesh_exchange = "off"
+        host_out, hrunner = _run(build())
+        assert hrunner.mesh_exchanges == 0
+        assert sorted(mesh_out.read()) == sorted(host_out.read())
+
+    def test_join_rides_exchange_and_matches_host(self):
+        left = [(i % 7, i) for i in range(600)]
+        right = [(i % 7, -i) for i in range(200) if i % 7 != 3]
+
+        def build():
+            lp = Dampr.memory(left, partitions=8).group_by(lambda x: x[0])
+            rp = Dampr.memory(right, partitions=8).group_by(lambda x: x[0])
+            return lp.join(rp).reduce(
+                lambda l, r: (sorted(v[1] for v in l)[:2],
+                              sorted(v[1] for v in r)[:2]))
+
+        mesh_out, runner = _run(build())
+        assert runner.mesh_exchanges >= 1
+        settings.mesh_exchange = "off"
+        host_out, _ = _run(build())
+        assert sorted(mesh_out.read()) == sorted(host_out.read())
+
+    def test_left_join_through_exchange(self):
+        left = [(i % 5, i) for i in range(100)]
+        right = [(0, "z"), (2, "y")]
+        lp = Dampr.memory(left, partitions=8).group_by(lambda x: x[0])
+        rp = Dampr.memory(right, partitions=8).group_by(lambda x: x[0])
+        pipe = lp.join(rp).left_reduce(
+            lambda l, r: (len(list(l)), len(list(r))))
+        ds, runner = _run(pipe)
+        assert runner.mesh_exchanges >= 1
+        got = dict(v for v in ds.read())
+        assert got == {0: (0, (20, 1)), 1: (1, (20, 0)), 2: (2, (20, 1)),
+                       3: (3, (20, 0)), 4: (4, (20, 0))}
+
+    def test_windowed_exchange_small_budget(self):
+        # A tiny budget forces many flush windows through the collective;
+        # results stay exact and per-group value order is preserved.
+        data = [(i % 3, i) for i in range(5000)]
+        pipe = (Dampr.memory(data, partitions=8)
+                .group_by(lambda x: x[0])
+                .reduce(lambda k, vs: [v[1] for v in vs][:5]))
+        ds, runner = _run(pipe, memory_budget=1 << 16)
+        assert runner.mesh_exchanges >= 1
+        got = dict(v for v in ds.read())
+        for k in range(3):
+            assert got[k] == (k, [x for x in range(5000)
+                                  if x % 3 == k][:5])
+
+    def test_exchange_off_never_engages(self):
+        settings.mesh_exchange = "off"
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .group_by(lambda x: x % 2)
+                .reduce(lambda k, vs: len(list(vs))))
+        _ds, runner = _run(pipe)
+        assert runner.mesh_exchanges == 0
