@@ -454,12 +454,16 @@ class MTRunner(object):
 
     # -- reduce ------------------------------------------------------------
     def _mesh_reduce(self, stage, entries):
-        """Distributed fast path for device-foldable associative reduces: one
-        mesh collective program (local fold -> all_to_all by hash ->
-        final fold) over every partition at once, replacing per-partition
-        host jobs.  Returns None whenever the host path is required for
-        exactness — object values, 32-bit lane overflow, a 64-bit key
-        collision, or an over-budget working set."""
+        """Distributed fast path for device-foldable associative reduces:
+        window-streamed mesh collective folds (local fold -> all_to_all by
+        hash -> final fold per window, partials re-folded through the same
+        program), so host memory is one window plus the distinct-key
+        accumulator — never the partition set, which may be arbitrarily
+        over-budget and spilled.  Returns None whenever the host path is
+        required for exactness: object values, lane overflow (every
+        mesh_keyed_fold call re-checks its inputs, and partial magnitudes
+        are bounded by element magnitudes, so per-call checks compose),
+        a 64-bit key collision, or accumulator cardinality past the budget."""
         mode = str(settings.mesh_fold).lower()
         if mode in ("off", "0", "false") or not settings.use_device:
             return None
@@ -477,76 +481,149 @@ class MTRunner(object):
         refs = list(entries[0].all_refs())
         if not refs:
             return storage.PartitionSet(self.n_partitions), 0, 1
-        # Cheap metadata checks before touching any (possibly spilled) data.
+        # Cheap metadata check before touching any (possibly spilled) data.
         if any(getattr(r, "value_dtype", object) == object for r in refs):
             return None
-        if sum(r.nbytes for r in refs) > self.store.budget:
-            return None
-        # Load incrementally, verifying 32-bit lane exactness per block (the
-        # abs-sum bound accumulates across blocks so per-group sums stay
-        # bounded) — bail before concatenating when any block disqualifies.
-        blocks = []
-        abs_sum = 0
-        for r in refs:
-            blk = r.get()
-            vals = blk.values
-            if vals.dtype == np.bool_:
-                vals = vals.astype(np.int64)
-                blk = Block(blk.keys, vals, blk.h1, blk.h2)
-            if vals.dtype == np.float64:
-                return None
-            if vals.dtype == np.int64 and not jax.config.jax_enable_x64:
-                if not len(vals):
-                    pass
-                elif (int(vals.min()) < -(2 ** 31 - 1) - 1
-                      or int(vals.max()) > 2 ** 31 - 1):
-                    return None
-                else:
-                    abs_sum += int(np.abs(vals).sum())
-                    if op.kind == "sum" and abs_sum > 2 ** 31 - 1:
-                        return None
-            blocks.append(blk)
-        cat = Block.concat(blocks)
-        del blocks
 
-        # Group on host once: vectorized hash sort + exact key repair gives
-        # both the collision check (adjacent groups sharing a 64-bit hash)
-        # and a vocabulary-sized hash->key table, replacing any per-record
-        # Python pass.
-        groups = segment.sort_and_group(cat)
-        starts, _ends = groups.bounds()
-        sb = groups.block
-        gh1 = sb.h1.take(starts)
-        gh2 = sb.h2.take(starts)
-        if len(starts) > 1 and bool(
-                np.any((gh1[1:] == gh1[:-1]) & (gh2[1:] == gh2[:-1]))):
-            log.info("mesh fold: 64-bit key collision, using host path")
-            return None
-        gkeys = sb.keys.take(starts)
-        lookup = {}
-        for i in range(len(starts)):
-            k = gkeys[i]
-            lookup[(int(gh1[i]), int(gh2[i]))] = (
-                k.item() if isinstance(k, np.generic) else k)
-
-        from .blocks import _column_from_list
+        from .blocks import _concat_cols
+        from .ops.hashing import combine64
         from .parallel import mesh_keyed_fold
         from .parallel.mesh import data_mesh
 
+        mesh = data_mesh()
+        x64 = jax.config.jax_enable_x64
+        window_budget = max(1 << 20, self.store.budget // 4)
+        acc_budget = max(1 << 20, self.store.budget // 4)
+
+        class _HostPath(Exception):
+            pass
+
+        # Distinct-key table: u64-sorted hash lanes with the matching keys.
+        # Grows with key cardinality only; replaces the former all-records
+        # host concat + sort + Python dict.
+        kt = {"u": np.empty(0, dtype=np.uint64),
+              "k": None}  # dtype set by the first window (stays numeric
+        #                   for numeric keys — the output block inherits it)
+        partials = []  # folded (h1, h2, v) lane triples
+
+        def keys_equal(a, b):
+            if a.dtype != object and b.dtype != object:
+                return bool(np.all(a == b))
+            return all(x == y for x, y in zip(a, b))
+
+        def merge_table(blk, h1, h2):
+            """Fold the window's (hash -> key) pairs into the sorted table —
+            sort only the window, then a linear searchsorted+insert merge —
+            verifying equal 64-bit hashes always carry equal keys."""
+            u = combine64(h1, h2)
+            worder = np.argsort(u, kind="stable")
+            su = u[worder]
+            sk = np.asarray(blk.keys).take(worder)
+            # In-window dedup with the collision check on adjacent dups.
+            first = np.empty(len(su), dtype=bool)
+            first[0] = True
+            np.not_equal(su[1:], su[:-1], out=first[1:])
+            dup = np.flatnonzero(~first)
+            if len(dup) and not keys_equal(sk.take(dup), sk.take(dup - 1)):
+                raise _HostPath  # 64-bit collision
+            keep = np.flatnonzero(first)
+            su = su[keep]
+            sk = sk.take(keep)
+            if kt["k"] is None:
+                kt["u"], kt["k"] = su, sk
+            else:
+                if kt["k"].dtype != sk.dtype:
+                    nk = len(kt["k"])
+                    both = _concat_cols([kt["k"], sk])
+                    kt["k"] = both[:nk]
+                    sk = both[nk:]
+                pos = np.searchsorted(kt["u"], su)
+                pos_c = np.minimum(pos, max(len(kt["u"]) - 1, 0))
+                exists = (kt["u"][pos_c] == su) if len(kt["u"]) else (
+                    np.zeros(len(su), dtype=bool))
+                hit = np.flatnonzero(exists)
+                if len(hit) and not keys_equal(
+                        sk.take(hit), kt["k"].take(pos_c[hit])):
+                    raise _HostPath  # cross-window 64-bit collision
+                new = np.flatnonzero(~exists)
+                if len(new):
+                    kt["u"] = np.insert(kt["u"], pos[new], su[new])
+                    kt["k"] = np.insert(kt["k"], pos[new], sk.take(new))
+            if len(kt["u"]) * 80 > acc_budget:
+                raise _HostPath  # extreme cardinality: stream on host
+
+        def compact():
+            h1 = np.concatenate([p[0] for p in partials])
+            h2 = np.concatenate([p[1] for p in partials])
+            v = np.concatenate([p[2] for p in partials])
+            try:
+                f = mesh_keyed_fold(mesh, h1, h2, v, op.kind)
+            except ValueError:
+                raise _HostPath
+            del partials[:]
+            partials.append(f)
+
+        def flush(win_blocks):
+            blk = Block.concat(win_blocks)
+            if not len(blk):
+                return
+            vals = blk.values
+            if vals.dtype == np.bool_:
+                vals = vals.astype(np.int64)
+            if vals.dtype == np.float64 and not x64:
+                raise _HostPath
+            h1, h2 = blk.hashes()
+            merge_table(blk, h1, h2)
+            try:
+                f = mesh_keyed_fold(mesh, h1, h2, vals, op.kind)
+            except ValueError:
+                raise _HostPath
+            partials.append(f)
+            if len(partials) >= _PARTIAL_FANIN:
+                compact()
+
         try:
-            fh1, fh2, fv = mesh_keyed_fold(data_mesh(), sb.h1, sb.h2,
-                                           sb.values, op.kind)
-        except ValueError:
+            win, wbytes = [], 0
+            for ref in refs:
+                for w in ref.iter_windows():
+                    if not len(w):
+                        continue
+                    win.append(w)
+                    wbytes += w.nbytes()
+                    if wbytes >= window_budget:
+                        flush(win)
+                        win, wbytes = [], 0
+            if win:
+                flush(win)
+            if not partials:
+                return storage.PartitionSet(self.n_partitions), 0, 1
+            if len(partials) > 1:
+                compact()
+        except _HostPath:
+            log.info("mesh fold: falling back to the host path")
             return None
+
+        fh1 = np.asarray(partials[0][0])
+        fh2 = np.asarray(partials[0][1])
+        fv = np.asarray(partials[0][2])
+        # Vectorized hash -> key join against the sorted table (every output
+        # hash entered the table with its window).
+        fu = combine64(fh1, fh2)
+        idx = np.minimum(np.searchsorted(kt["u"], fu), len(kt["u"]) - 1)
+        assert bool(np.all(kt["u"][idx] == fu)), "mesh fold lost a key"
+        out_keys = kt["k"].take(idx)
 
         P = self.n_partitions
         pin = bool(stage.options.get("memory"))
-        keys_list = [lookup[(int(a), int(b))] for a, b in zip(fh1, fh2)]
-        vcol = np.empty(len(keys_list), dtype=object)
-        for i, k in enumerate(keys_list):
+        n = len(fu)
+        vcol = np.empty(n, dtype=object)
+        for i in range(n):
+            k = out_keys[i]
+            if isinstance(k, np.generic):
+                k = k.item()
             v = fv[i]
             vcol[i] = (k, v.item() if isinstance(v, np.generic) else v)
-        out_blk = Block(_column_from_list(keys_list), vcol, fh1, fh2)
+        out_blk = Block(out_keys, vcol, fh1, fh2)
 
         pset = storage.PartitionSet(P)
         nrec = 0
@@ -585,33 +662,58 @@ class MTRunner(object):
         window = max(1 << 18, self.store.budget // (8 * D * D))
 
         out_entries = []
+        ran_exchange = False
         for pset in entries:
             out = storage.PartitionSet(pset.n_partitions)
             batch, batch_bytes = [], 0
             seq = 0
 
             def flush():
-                nonlocal batch, batch_bytes
+                nonlocal batch, batch_bytes, ran_exchange
                 if not batch:
                     return
-                routed = [(s, s % D, pid, ref.get())
-                          for s, pid, ref in batch]
+                routed = [
+                    (s, s % D, pid,
+                     item.get() if isinstance(item, storage.BlockRef)
+                     else item)
+                    for s, pid, item in batch]
                 received, moved = px.mesh_shuffle_blocks(mesh, routed)
                 for pid, blk in received:
                     out.add(pid, self.store.register(blk))
                 self.mesh_exchange_bytes += moved
+                ran_exchange = True
                 batch, batch_bytes = [], 0
+
+            def add(pid, item, nbytes):
+                nonlocal batch_bytes, seq
+                batch.append((seq, pid, item))
+                seq += 1
+                batch_bytes += nbytes
+                if batch_bytes >= window:
+                    flush()
 
             for pid in sorted(pset.parts):
                 for ref in pset.parts[pid]:
-                    batch.append((seq, pid, ref))
-                    seq += 1
-                    batch_bytes += ref.nbytes
-                    if batch_bytes >= window:
-                        flush()
+                    if ref.nbytes <= window:
+                        add(pid, ref, ref.nbytes)
+                        continue
+                    # An over-window block would amplify to a D*D-row buffer
+                    # of its own pow2 size; stream it in bounded pieces
+                    # instead (consecutive slices of a sorted run stay
+                    # sorted runs, and seq order keeps arrival order).
+                    piece, pbytes = [], 0
+                    for w in ref.iter_windows():
+                        piece.append(w)
+                        pbytes += w.nbytes()
+                        if pbytes >= window:
+                            add(pid, Block.concat(piece), pbytes)
+                            piece, pbytes = [], 0
+                    if piece:
+                        add(pid, Block.concat(piece), pbytes)
             flush()
             out_entries.append(out)
-        self.mesh_exchanges += 1
+        if ran_exchange:
+            self.mesh_exchanges += 1
         return out_entries
 
     def run_reduce(self, stage_id, stage, env):

@@ -133,6 +133,27 @@ class TestEngineExchange:
             assert got[k] == (k, [x for x in range(5000)
                                   if x % 3 == k][:5])
 
+    def test_over_window_ref_streams_in_pieces(self):
+        # One partition holding a block far larger than the exchange window
+        # must stream through the collective in bounded pieces, not allocate
+        # a D*D amplification of the whole block.
+        data = [(0, "x" * 50) for _ in range(20000)]  # one hot key
+        pipe = (Dampr.memory(data, partitions=8)
+                .group_by(lambda x: x[0])
+                .reduce(lambda k, vs: len(list(vs))))
+        ds, runner = _run(pipe, memory_budget=1 << 18)
+        assert runner.mesh_exchanges >= 1
+        got = dict(v for v in ds.read())
+        assert got == {0: (0, 20000)}
+
+    def test_empty_input_does_not_count_exchange(self):
+        pipe = (Dampr.memory([], partitions=4)
+                .group_by(lambda x: x)
+                .reduce(lambda k, vs: len(list(vs))))
+        ds, runner = _run(pipe)
+        assert list(ds.read()) == []
+        assert runner.mesh_exchanges == 0  # nothing actually crossed
+
     def test_exchange_off_never_engages(self):
         settings.mesh_exchange = "off"
         pipe = (Dampr.memory(list(range(100)), partitions=4)
