@@ -49,10 +49,26 @@ def _segments(inv, h1, h2):
     return jnp.where(iota == 0, True, prev_ne)
 
 
-def _local_fold(inv, h1, h2, v, kind):
+def _local_fold(inv, h1, h2, v, kind, nonneg_sum=False):
     """Sort by (validity, h1, h2) and fold values per segment.  Returns
     (inv, h1, h2, v) arrays of the same length: one live entry per segment,
-    dead entries marked invalid."""
+    dead entries marked invalid.
+
+    Two lowerings, selected statically:
+
+    - ``nonneg_sum`` (the count/len/doc-freq hot path): pure scan fold —
+      sort, then segment totals land at segment *end* positions via
+      ``cumsum`` + a ``cummax``-carried start offset.  No scatter at all;
+      on a v5e this runs 6.7x faster than the scatter lowering because XLA's
+      TPU scatter serializes random updates while sort and scan are
+      bandwidth-bound (measured: 279 vs 42 M records/s at 4M records —
+      benchmarks/RESULTS.md).  Exact because the host wrapper only sets the
+      flag for signed integer values whose *global* sum fits the lane dtype,
+      so the running cumsum cannot wrap and is order-exact.
+    - otherwise: segment_sum/min/max scatters into segment-id slots (handles
+      negative sums and min/max, where a monotone carried scan doesn't
+      apply).
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -60,6 +76,19 @@ def _local_fold(inv, h1, h2, v, kind):
     n = h1.shape[0]
     inv, h1, h2, v = lax.sort((inv, h1, h2, v), num_keys=3, is_stable=True)
     starts = _segments(inv, h1, h2)
+
+    if nonneg_sum and kind == "sum":
+        ends = jnp.concatenate(
+            [starts[1:], jnp.ones((1,), dtype=starts.dtype)])
+        csum = jnp.cumsum(v)
+        ex = csum - v  # exclusive prefix, nonneg + monotone by assumption
+        start_ex = lax.cummax(jnp.where(starts, ex, -1))
+        tot = jnp.where(ends, csum - start_ex, 0).astype(v.dtype)
+        # The end entry of a segment carries the segment's own (h1, h2);
+        # invalid records sort last and form all-invalid segments.
+        live = ends & (inv == 0)
+        return (jnp.where(live, jnp.uint32(0), jnp.uint32(1)), h1, h2, tot)
+
     seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
     if kind == "sum":
         folded = jax.ops.segment_sum(v, seg_id, num_segments=n)
@@ -122,7 +151,7 @@ def _pack_by_dest(inv, h1, h2, v, n_dev, capacity):
 
 @functools.lru_cache(maxsize=None)
 def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
-                        axis):
+                        axis, nonneg_sum=False):
     """Compile the full shard_map keyed-fold program for one shape bucket.
     ``mesh`` participates in the cache key so re-meshing recompiles."""
     import jax
@@ -137,7 +166,7 @@ def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
         inv = jnp.where(valid == 1, jnp.uint32(0), jnp.uint32(1))
 
         # 1. local combine
-        inv, h1, h2, v = _local_fold(inv, h1, h2, v, kind)
+        inv, h1, h2, v = _local_fold(inv, h1, h2, v, kind, nonneg_sum)
 
         # 2. pack per destination
         ok, sh1, sh2, sv, dropped = _pack_by_dest(inv, h1, h2, v, n_dev,
@@ -149,11 +178,13 @@ def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
         rh2 = lax.all_to_all(sh2, axis, split_axis=0, concat_axis=0)
         rv = lax.all_to_all(sv, axis, split_axis=0, concat_axis=0)
 
-        # 4. final fold over everything received
+        # 4. final fold over everything received (partial sums of nonneg
+        # values stay nonneg, so the scan lowering remains applicable)
         flat = n_dev * capacity
         inv2 = jnp.where(rok.reshape(flat) == 1, jnp.uint32(0), jnp.uint32(1))
         inv2, fh1, fh2, fv = _local_fold(
-            inv2, rh1.reshape(flat), rh2.reshape(flat), rv.reshape(flat), kind)
+            inv2, rh1.reshape(flat), rh2.reshape(flat), rv.reshape(flat),
+            kind, nonneg_sum)
 
         total_dropped = lax.psum(dropped, axis)
         out_valid = jnp.where(inv2 == 0, jnp.uint32(1), jnp.uint32(0))
@@ -254,9 +285,28 @@ def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):
     factor = capacity_factor or settings.shuffle_capacity_factor
     capacity = max(8, int(-(-n_local // n_dev) * factor))
     axis = settings.mesh_axis
+    # Integer nonneg sums (count/len/doc-freq — the hot aggregations) take
+    # the scan fold lowering (padding rows are zero, so they cannot break
+    # the nonneg invariant).  The lowering needs (a) a signed dtype — its -1
+    # start sentinel wraps on unsigned lanes — and (b) a global-cumsum bound
+    # in the lane dtype, not just per-key bounds: with x64 off the
+    # _lane_safe_values cast above already proved abs-sum <= int32 max; with
+    # x64 on the values passed through unchecked, so bound them here.
+    nonneg = False
+    if (kind == "sum" and v.dtype.kind == "i"
+            and (not len(v) or int(v.min()) >= 0)):
+        if not len(v):
+            nonneg = True
+        elif v.dtype == np.int32:
+            if jax.config.jax_enable_x64:
+                nonneg = int(v.sum(dtype=np.int64)) <= _I32_MAX
+            else:
+                nonneg = True  # abs-sum check ran in _lane_safe_values
+        elif v.dtype == np.int64:
+            nonneg = len(v) * int(v.max()) <= _I64_MAX
     while True:
         prog = _build_fold_program(mesh, n_dev, n_local, capacity, kind,
-                                   np.dtype(v.dtype).name, axis)
+                                   np.dtype(v.dtype).name, axis, nonneg)
         fh1, fh2, fv, ok, dropped = prog(ph1, ph2, pv, pvalid)
         if int(dropped) == 0:
             mask = np.asarray(ok) == 1

@@ -149,6 +149,45 @@ class TestMeshLanes:
         assert mesh_global_sum(mesh8, vals) == 1000 * 2 ** 20
 
 
+class TestX64ScanGuards:
+    def test_x64_scan_lowering_guards(self):
+        # Under jax_enable_x64 the _lane_safe_values int32 cast (and its
+        # abs-sum proof) is skipped, so the scan lowering must re-check the
+        # global-cumsum bound and refuse unsigned dtypes (its -1 sentinel
+        # wraps).  Runs in a subprocess because x64 is process-global.
+        import os
+        import subprocess
+        import sys
+
+        code = (
+            "import os\n"
+            "os.environ['XLA_FLAGS'] = "
+            "'--xla_force_host_platform_device_count=8'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            "import numpy as np\n"
+            "from dampr_tpu import settings\n"
+            "settings.device_min_batch = 1\n"
+            "from dampr_tpu.ops import hashing\n"
+            "from dampr_tpu.parallel import mesh_keyed_fold\n"
+            "from dampr_tpu.parallel.mesh import data_mesh\n"
+            "mesh = data_mesh()\n"
+            "h1, h2 = hashing.hash_keys(np.arange(3))\n"
+            "_, _, fv = mesh_keyed_fold(mesh, h1, h2,\n"
+            "    np.array([1500000000] * 3, dtype=np.int32), 'sum')\n"
+            "assert sorted(fv.tolist()) == [1500000000] * 3, fv\n"
+            "_, _, fv = mesh_keyed_fold(mesh, h1, h2,\n"
+            "    np.array([1, 2, 3], dtype=np.uint32), 'sum')\n"
+            "assert sorted(fv.tolist()) == [1, 2, 3], fv\n"
+            "print('OK')\n")
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=300)
+        assert "OK" in r.stdout, (r.stdout, r.stderr)
+
+
 class TestIndexerQuoting:
     def test_keys_with_quotes_do_not_crash(self, tmp_path):
         from dampr_tpu.utils import Indexer

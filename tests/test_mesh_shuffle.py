@@ -40,6 +40,39 @@ class TestMeshKeyedFold:
             want[k] = want.get(k, 0) + v
         assert got == want
 
+    def test_sum_negative_values_scatter_path(self, mesh8):
+        # Negative values must miss the nonneg scan lowering and still fold
+        # exactly through the scatter path.
+        rng = np.random.RandomState(11)
+        keys = rng.randint(0, 500, size=30000)
+        vals = rng.randint(-50, 50, size=30000).astype(np.int64)
+        h1, h2 = hashing.hash_keys(keys)
+        got = _fold_to_dict(list(range(500)),
+                            *mesh_keyed_fold(mesh8, h1, h2, vals, "sum"))
+        want = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            want[k] = want.get(k, 0) + v
+        assert got == want
+
+    def test_scan_and_scatter_lowerings_agree(self, mesh8):
+        # Same nonneg data through both static lowerings of the fold program.
+        from dampr_tpu.parallel import shuffle as sh
+        rng = np.random.RandomState(13)
+        keys = rng.randint(0, 777, size=20000)
+        vals = rng.randint(0, 9, size=20000).astype(np.int64)
+        h1, h2 = hashing.hash_keys(keys)
+        a = _fold_to_dict(list(range(777)),
+                          *mesh_keyed_fold(mesh8, h1, h2, vals, "sum"))
+        # force the scatter lowering by shifting through a negative no-op
+        vals2 = np.concatenate([vals, np.array([-1, 1], dtype=np.int64)])
+        extra = hashing.hash_keys(np.array([999888, 999888]))
+        h1b = np.concatenate([h1, extra[0]])
+        h2b = np.concatenate([h2, extra[1]])
+        b = _fold_to_dict(list(range(777)) + [999888],
+                          *mesh_keyed_fold(mesh8, h1b, h2b, vals2, "sum"))
+        assert b.pop(999888) == 0
+        assert a == b
+
     def test_min_max(self, mesh8):
         rng = np.random.RandomState(3)
         keys = rng.randint(0, 64, size=4096)
