@@ -1,171 +1,10 @@
-"""Benchmark: the reference's headline TF-IDF workload (benchmarks/run.sh +
-tf-idf-dampr.py) on dampr_tpu, vs the reference's own single-core CPU
-baseline shape (benchmarks/baseline.py).
+"""Driver hook: the TF-IDF headline benchmark.
 
-Workload (identical to reference tf-idf-dampr.py:9-21): per line, document
-frequency of lowercased ``[^\\w]+``-split tokens; then idf = log(1 + total/df)
-via a broadcast cross with the corpus line count; sunk as TSV.
-
-Baseline (identical to reference benchmarks/baseline.py:12-24): single-core
-Python ``Counter`` over per-line token sets, writing the same TSV.  (Both
-sides drop the empty-string pseudo-token re.split emits at line edges.)
-
-Corpus: deterministic synthetic Zipf text (the reference uses duplicated
-Shakespeare; this container has no corpus and zero egress).  Size via
-DAMPR_BENCH_MB (default 64).
-
-Prints ONE JSON line:
-  {"metric": "tfidf_docfreq_throughput", "value": <MB/s>, "unit": "MB/s",
-   "vs_baseline": <ours / single-core-baseline>}
+Thin wrapper over :mod:`dampr_tpu.bench_tfidf` (also installed as the
+``dampr-tpu-bench`` console script); prints ONE JSON line.
 """
 
-import json
-import math
-import multiprocessing
-import operator
-import os
-import re
-import shutil
-import sys
-import time
-from collections import Counter
-
-BENCH_DIR = os.environ.get("DAMPR_BENCH_DIR", "/tmp/dampr_tpu_bench")
-BENCH_MB = int(os.environ.get("DAMPR_BENCH_MB", "128"))
-
-RX = re.compile(r"[^\w]+")
-
-
-def log(msg):
-    print(msg, file=sys.stderr, flush=True)
-
-
-def make_corpus(path, mb):
-    """Deterministic Zipf-ish text: ~24k-word vocabulary, ~8-12 tokens/line
-    (the Shakespeare corpus shape: 5.3MB, 23,903 unique words)."""
-    import numpy as np
-
-    if os.path.exists(path) and os.path.getsize(path) >= mb * 1024 ** 2:
-        return
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    rng = np.random.RandomState(1234)
-    vocab_n = 24000
-    vocab = np.array(["w%04x" % i if i > 200 else "t%d" % i
-                      for i in range(vocab_n)], dtype=object)
-    # Zipf ranks: common words dominate like natural text
-    probs = 1.0 / np.arange(1, vocab_n + 1) ** 1.1
-    probs /= probs.sum()
-    target = mb * 1024 ** 2
-    written = 0
-    with open(path, "w") as f:
-        while written < target:
-            ids = rng.choice(vocab_n, size=(20000,), p=probs)
-            lens = rng.randint(8, 13, size=2000)
-            pos = 0
-            out = []
-            for L in lens:
-                out.append(" ".join(vocab[ids[pos:pos + L]]))
-                pos += L
-                if pos + 13 > len(ids):
-                    break
-            chunk = "\n".join(out) + "\n"
-            f.write(chunk)
-            written += len(chunk)
-    log("corpus: {} ({:.1f} MB)".format(path, written / 1e6))
-
-
-def run_baseline(corpus, outdir):
-    """Reference benchmarks/baseline.py, verbatim shape: single core."""
-    if os.path.isdir(outdir):
-        shutil.rmtree(outdir)
-    os.makedirs(outdir)
-    t0 = time.time()
-    with open(corpus) as f:
-        counter = Counter()
-        num_rows = 0
-        for num_rows, line in enumerate(f):
-            counter.update(t for t in set(RX.split(line.lower())) if t)
-        total = num_rows + 1
-    with open(os.path.join(outdir, "out"), "w") as out:
-        for word, count in counter.items():
-            print("\t".join((word, str(count),
-                             str(math.log(1 + float(total) / count)))),
-                  file=out)
-    secs = time.time() - t0
-    return secs, counter, total
-
-
-def run_dampr_tpu(corpus, outdir):
-    """Reference tf-idf-dampr.py shape on the new engine: vectorized DocFreq
-    map (native tokenize+count), device-capable fold, broadcast idf join,
-    TSV sink."""
-    from dampr_tpu import Dampr
-    from dampr_tpu.ops.text import DocFreq
-
-    if os.path.isdir(outdir):
-        shutil.rmtree(outdir)
-
-    chunk_size = os.path.getsize(corpus) // multiprocessing.cpu_count() + 1
-    t0 = time.time()
-    docs = Dampr.text(corpus, chunk_size)
-    doc_freq = (docs.custom_mapper(DocFreq(mode="word", lower=True))
-                .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
-    idf = doc_freq.cross_right(
-        docs.len(),
-        lambda df, total: (df[0], df[1],
-                           math.log(1 + (float(total) / df[1]))),
-        memory=True)
-    idf.sink_tsv(outdir).run(name="bench-tfidf")
-    secs = time.time() - t0
-    return secs
-
-
-def check_result(outdir, counter, total):
-    got = {}
-    for part in sorted(os.listdir(outdir)):
-        with open(os.path.join(outdir, part)) as f:
-            for line in f:
-                w, c, idf = line.rstrip("\n").split("\t")
-                got[w] = (int(c), float(idf))
-    want = {w: (c, math.log(1 + float(total) / c))
-            for w, c in counter.items()}
-    assert set(got) == set(want), (
-        "token sets differ: {} extra, {} missing".format(
-            len(set(got) - set(want)), len(set(want) - set(got))))
-    for w, (c, i) in want.items():
-        gc, gi = got[w]
-        assert gc == c, (w, gc, c)
-        assert abs(gi - i) < 1e-9, (w, gi, i)
-    return len(got)
-
-
-def main():
-    corpus = os.path.join(BENCH_DIR, "corpus_{}mb.txt".format(BENCH_MB))
-    make_corpus(corpus, BENCH_MB)
-    size_mb = os.path.getsize(corpus) / 1e6
-
-    base_secs, counter, total = run_baseline(
-        corpus, os.path.join(BENCH_DIR, "baseline-idf"))
-    log("baseline (1 core): {:.2f}s = {:.1f} MB/s".format(
-        base_secs, size_mb / base_secs))
-
-    ours_dir = os.path.join(BENCH_DIR, "dampr-idf")
-    warm = run_dampr_tpu(corpus, ours_dir)
-    log("dampr_tpu cold: {:.2f}s".format(warm))
-    secs = run_dampr_tpu(corpus, ours_dir)
-    log("dampr_tpu warm: {:.2f}s = {:.1f} MB/s".format(secs, size_mb / secs))
-
-    n = check_result(ours_dir, counter, total)
-    log("verified {} idf entries match baseline exactly".format(n))
-
-    value = size_mb / secs
-    print(json.dumps({
-        "metric": "tfidf_docfreq_throughput",
-        "value": round(value, 2),
-        "unit": "MB/s",
-        "vs_baseline": round(value / (size_mb / base_secs), 2),
-    }))
-
+from dampr_tpu.bench_tfidf import main
 
 if __name__ == "__main__":
     main()

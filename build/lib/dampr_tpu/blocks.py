@@ -1,0 +1,245 @@
+"""Columnar KV record blocks — the data substrate.
+
+Replaces the reference's pickled-batch-in-gzip record streams (reference
+dampr/dataset.py:20-41 ``dump_pickle``/``gzip_reader``) with columnar batches:
+
+- ``keys``:   numpy array — int64/float64 fast lanes, or object dtype (strings,
+              tuples, arbitrary Python).
+- ``values``: numpy array — int64/float64 fast lanes (device-reducible), or object.
+- ``h1/h2``:  cached dual uint32 hash lanes (ops/hashing.py) used for partition
+              routing and sort-based grouping.
+
+Blocks are the unit of streaming, spill, and shard exchange.  The numeric lanes stay
+eligible for device kernels end-to-end; object lanes ride along host-side while all
+keyed *routing* decisions (hash, partition id, sort permutation) still come from the
+vectorized path.
+
+Exactness: blocks always carry the real key column, so sort-based grouping verifies
+that records sharing a 64-bit hash also share a key (adjacent vectorized compare) and
+sub-groups on the astronomically-rare mismatch — grouping is exact, never
+hash-approximate.
+"""
+
+import numpy as np
+
+from .ops import hashing
+
+_INT_TYPES = (int, bool)
+
+# int64-representable bounds for Python ints (reference values are arbitrary
+# precision; anything outside drops to the object lane).
+_I64_MIN = -(2 ** 63)
+_I64_MAX = 2 ** 63 - 1
+
+
+def _column_from_list(xs):
+    """Build the tightest column for a list of Python values."""
+    n = len(xs)
+    ts = set(map(type, xs))
+    if ts == {bool}:
+        # Preserve bool values exactly (True round-trips as True, not 1); the
+        # reference's pickled streams preserve bools and so do we.  Mixed
+        # bool/number columns drop to the object lane below for the same
+        # reason — casting would read True back as 1.
+        return np.fromiter(xs, dtype=np.bool_, count=n)
+    if ts == {int}:
+        try:
+            arr = np.empty(n, dtype=np.int64)
+            for i, x in enumerate(xs):
+                arr[i] = x
+            return arr
+        except OverflowError:
+            pass
+    elif ts == {float}:
+        return np.fromiter(xs, dtype=np.float64, count=n)
+    elif ts == {float, int}:
+        # Mixed int/float: float64 only when every int is exactly representable
+        # (|i| <= 2**53); otherwise the object lane preserves precision.
+        if all(isinstance(x, float) or abs(x) <= 2 ** 53 for x in xs):
+            return np.array([float(x) for x in xs], dtype=np.float64)
+    out = np.empty(n, dtype=object)
+    out[:] = xs
+    return out
+
+
+def is_numeric(col):
+    return col.dtype != object
+
+
+class Block(object):
+    __slots__ = ("keys", "values", "h1", "h2")
+
+    def __init__(self, keys, values, h1=None, h2=None):
+        assert len(keys) == len(values)
+        self.keys = keys
+        self.values = values
+        self.h1 = h1
+        self.h2 = h2
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_pairs(cls, pairs):
+        """Build a block from a list of (key, value) tuples."""
+        n = len(pairs)
+        ks = [None] * n
+        vs = [None] * n
+        for i, (k, v) in enumerate(pairs):
+            ks[i] = k
+            vs[i] = v
+        return cls(_column_from_list(ks), _column_from_list(vs))
+
+    @classmethod
+    def empty(cls):
+        return cls(np.empty(0, dtype=object), np.empty(0, dtype=object),
+                   np.empty(0, dtype=np.uint32), np.empty(0, dtype=np.uint32))
+
+    @classmethod
+    def concat(cls, blocks):
+        blocks = [b for b in blocks if len(b)]
+        if not blocks:
+            return cls.empty()
+        if len(blocks) == 1:
+            return blocks[0]
+        keys = _concat_cols([b.keys for b in blocks])
+        values = _concat_cols([b.values for b in blocks])
+        if all(b.h1 is not None for b in blocks):
+            h1 = np.concatenate([b.h1 for b in blocks])
+            h2 = np.concatenate([b.h2 for b in blocks])
+        else:
+            h1 = h2 = None
+        return cls(keys, values, h1, h2)
+
+    # -- basics ------------------------------------------------------------
+    def __len__(self):
+        return len(self.keys)
+
+    @property
+    def numeric_values(self):
+        return is_numeric(self.values)
+
+    @property
+    def numeric_keys(self):
+        return is_numeric(self.keys)
+
+    def nbytes(self):
+        kb = self.keys.nbytes if self.numeric_keys else len(self.keys) * 64
+        vb = self.values.nbytes if self.numeric_values else len(self.values) * 64
+        hb = 0 if self.h1 is None else self.h1.nbytes * 2
+        return kb + vb + hb
+
+    def iter_pairs(self):
+        ks, vs = self.keys, self.values
+        for i in range(len(ks)):
+            k = ks[i]
+            v = vs[i]
+            yield (k.item() if isinstance(k, np.generic) else k,
+                   v.item() if isinstance(v, np.generic) else v)
+
+    # -- hashing / routing -------------------------------------------------
+    def hashes(self):
+        if self.h1 is None:
+            self.h1, self.h2 = hashing.hash_keys(self.keys)
+        return self.h1, self.h2
+
+    def h64(self):
+        h1, h2 = self.hashes()
+        return hashing.combine64(h1, h2)
+
+    def take(self, idx):
+        return Block(
+            self.keys.take(idx),
+            self.values.take(idx),
+            None if self.h1 is None else self.h1.take(idx),
+            None if self.h2 is None else self.h2.take(idx),
+        )
+
+    def sort_by_hash(self):
+        """Stable sort by the (h1, h2) lanes — makes the block a mergeable
+        run; equal keys (equal hashes) keep arrival order."""
+        h1, h2 = self.hashes()
+        order = np.lexsort((h2, h1))
+        return self.take(order)
+
+    def partition_ids(self, n_partitions):
+        h1, _ = self.hashes()
+        return (h1 % np.uint32(n_partitions)).astype(np.int32)
+
+    def split_by_partition(self, n_partitions):
+        """Route records to shuffle partitions by h1 % P (the reference's
+        ``Splitter.partition``, base.py:6-8, vectorized).  Returns {pid: Block}
+        for non-empty partitions only."""
+        if not len(self):
+            return {}
+        pids = self.partition_ids(n_partitions)
+        order = np.argsort(pids, kind="stable")
+        sorted_pids = pids[order]
+        bounds = np.flatnonzero(np.diff(sorted_pids)) + 1
+        out = {}
+        start = 0
+        for end in list(bounds) + [len(sorted_pids)]:
+            if end > start:
+                pid = int(sorted_pids[start])
+                out[pid] = self.take(order[start:end])
+            start = end
+        return out
+
+
+def _concat_cols(cols):
+    dtypes = {c.dtype for c in cols}
+    if len(dtypes) == 1 and object not in dtypes:
+        return np.concatenate(cols)
+    if object not in dtypes:
+        # Mixed numeric dtypes.  Promotion must obey the same value-preserving
+        # rules as _column_from_list: bools never silently become numbers, and
+        # int64 joins float64 only when every int is float-exact.
+        if any(dt == np.bool_ for dt in dtypes):
+            return _as_object_concat(cols)
+        target = np.result_type(*dtypes)
+        if target.kind == "f":
+            for c in cols:
+                if c.dtype.kind in "iu" and len(c) and (
+                        np.abs(c).max() > 2 ** 53):
+                    return _as_object_concat(cols)
+        return np.concatenate([c.astype(target) for c in cols])
+    return _as_object_concat(cols)
+
+
+def _as_object_concat(cols):
+    total = sum(len(c) for c in cols)
+    out = np.empty(total, dtype=object)
+    at = 0
+    for c in cols:
+        if c.dtype == object:
+            out[at: at + len(c)] = c
+        else:
+            # .item()-ize so downstream sees Python scalars, matching
+            # iter_pairs semantics for values that started in object lanes.
+            out[at: at + len(c)] = [x.item() for x in c]
+        at += len(c)
+    return out
+
+
+class BlockBuilder(object):
+    """Accumulates (k, v) pairs and emits Blocks of ~settings.batch_size records.
+
+    The streaming analog of the reference's DatasetWriter buffering
+    (dataset.py:59-82), but batch-oriented so downstream kernels see large
+    vectorizable chunks.
+    """
+
+    def __init__(self, batch_size):
+        self.batch_size = batch_size
+        self._buf = []
+
+    def add(self, k, v):
+        self._buf.append((k, v))
+        if len(self._buf) >= self.batch_size:
+            return self.flush()
+        return None
+
+    def flush(self):
+        if not self._buf:
+            return None
+        blk = Block.from_pairs(self._buf)
+        self._buf = []
+        return blk

@@ -1,0 +1,61 @@
+"""Console entry points (pyproject [project.scripts]).
+
+The reference installs as a plain library with ``test_suite`` wiring only
+(reference setup.py:1-20); these go further: the benchmark and the two
+canonical workloads run from an installed package without a repo checkout.
+
+- ``dampr-tpu-bench``  — the TF-IDF headline benchmark (same code path the
+  repo-root ``bench.py`` driver hook runs; DAMPR_BENCH_MB sizes the corpus).
+- ``dampr-tpu-wc``     — word count over a file/dir, top-20 to stdout.
+- ``dampr-tpu-tfidf``  — TF-IDF over a file/dir, TSV parts to --out.
+"""
+
+import argparse
+import math
+import operator
+import os
+
+
+def bench():
+    from .bench_tfidf import main
+    main()
+
+
+def wc():
+    ap = argparse.ArgumentParser(description="word count (top 20)")
+    ap.add_argument("path")
+    ap.add_argument("--chunk-mb", type=int, default=16)
+    args = ap.parse_args()
+
+    from . import Dampr
+
+    counts = (Dampr.text(args.path, chunk_size=args.chunk_mb * 1024 ** 2)
+              .flat_map(lambda line: line.split())
+              .fold_by(lambda w: w, binop=operator.add, value=lambda w: 1)
+              .run("wc-cli"))
+    for word, count in sorted(counts, key=lambda kv: kv[1],
+                              reverse=True)[:20]:
+        print("{}: {}".format(word, count))
+    counts.delete()
+
+
+def tf_idf():
+    ap = argparse.ArgumentParser(description="TF-IDF -> TSV parts")
+    ap.add_argument("path")
+    ap.add_argument("--out", default="/tmp/dampr_tpu_idfs")
+    args = ap.parse_args()
+
+    from . import Dampr
+    from .ops.text import DocFreq
+
+    chunk = (os.path.getsize(args.path) + 1
+             if os.path.isfile(args.path) else 16 * 1024 ** 2)
+    docs = Dampr.text(args.path, chunk)
+    df = (docs.custom_mapper(DocFreq(mode="word", lower=True))
+          .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
+    idf = df.cross_right(
+        docs.len(),
+        lambda d, total: (d[0], d[1], math.log(1 + float(total) / d[1])),
+        memory=True)
+    idf.sink_tsv(args.out).run("tfidf-cli")
+    print("TSV parts in {}".format(args.out))

@@ -1,0 +1,595 @@
+"""The fluent DSL: lazy, value-semantic pipeline construction.
+
+Parity surface: reference dampr/dampr.py (977 LoC) — ``Dampr`` entrypoints
+(memory/text/json/read_input/from_dataset, 845-912), ``PMap`` chainable
+collection ops (85-652), ``ARReduce`` associative reduces (654-709),
+``PReduce`` general reduces (711-766), ``PJoin`` (768-829), ``ValueEmitter``
+(19-51), map fusion (959-967), multi-output ``Dampr.run`` (914-945).
+
+Semantics preserved exactly: handles are immutable (every op returns a new
+handle over a copied graph), consecutive per-record ops fuse into one map
+stage, ``a_group_by`` installs a map-side combiner, ``join`` unions graphs
+deduping shared prefixes, results stream back key-sorted.
+
+TPU-native difference: ``a_group_by``/``fold_by``/``count``/``sum``/``mean``
+carry :class:`~dampr_tpu.ops.segment.AssocOp` descriptors, so recognized
+associative folds execute as device segment kernels end-to-end instead of
+per-record Python.
+"""
+
+import itertools
+import json
+import logging
+import random
+import sys
+import threading
+import time
+
+from .base import (AssocFoldReducer, KeyedInnerJoin, KeyedLeftJoin,
+                   KeyedOuterJoin, KeyedReduce, Map, MapAllJoin, MapCrossJoin,
+                   Mapper, PartialReduceCombiner, Reducer, StreamMapper,
+                   StreamReducer, Streamable, fuse)
+from .dataset import CatDataset, Chunker
+from .graph import Graph, Source
+from .inputs import MemoryInput, PathInput, UrlsInput
+from .ops import segment
+from .runner import MTRunner
+
+
+class ValueEmitter(object):
+    """Reads values from a completed run — the shell-friendly result handle
+    (reference dampr.py:19-51).  ``stats`` holds the run's per-stage metrics
+    (jobs, records, seconds) — observability the reference lacks."""
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+        self.stats = []
+
+    def stream(self):
+        for _k, v in self.dataset.read():
+            yield v
+
+    def read(self, k=None):
+        if k is None:
+            return list(self.stream())
+        return list(itertools.islice(self.stream(), k))
+
+    def __iter__(self):
+        return self.stream()
+
+    def delete(self):
+        self.dataset.delete()
+
+
+def _identity(k, v):
+    yield k, v
+
+
+class PBase(object):
+    def __init__(self, source, pmer):
+        assert isinstance(source, Source)
+        self.source = source
+        self.pmer = pmer
+
+    def run(self, name=None, **kwargs):
+        """Evaluate the composed graph; returns a ValueEmitter (its ``stats``
+        attribute carries per-stage timing/record counters)."""
+        if name is None:
+            name = "dampr/{}".format(random.random())
+        runner = self.pmer.runner(name, self.pmer.graph, **kwargs)
+        ds = runner.run([self.source])
+        em = ValueEmitter(ds[0])
+        em.stats = [s.as_dict() for s in getattr(runner, "stats", [])]
+        return em
+
+    def read(self, k=None, **kwargs):
+        """Shorthand for run() + read()."""
+        return self.run(**kwargs).read(k)
+
+
+class PMap(PBase):
+    """A lazy collection; consecutive per-record ops are queued in ``agg`` and
+    fused into a single map stage at the next checkpoint."""
+
+    def __init__(self, source, pmer, agg=None):
+        super(PMap, self).__init__(source, pmer)
+        self.agg = [] if agg is None else agg
+
+    def run(self, name=None, **kwargs):
+        if len(self.agg) > 0:
+            return self.checkpoint().run(name, **kwargs)
+        return super(PMap, self).run(name, **kwargs)
+
+    # -- fusion plumbing ---------------------------------------------------
+    def _add_mapper(self, mapper):
+        assert isinstance(mapper, Streamable)
+        return PMap(self.source, self.pmer, self.agg + [mapper])
+
+    def _add_map(self, f):
+        return self._add_mapper(Map(f))
+
+    def checkpoint(self, force=False, combiner=None, options=None):
+        """Fuse queued maps into a materialized stage boundary; shared
+        sub-graphs are then computed once (dedup happens in Graph.union)."""
+        if len(self.agg) > 0 or force:
+            aggs = [Map(_identity)] if len(self.agg) == 0 else self.agg[:]
+            source, pmer = self.pmer._add_mapper(
+                [self.source], fuse(aggs), combiner=combiner, options=options)
+            return PMap(source, pmer)
+        return self
+
+    # -- per-record ops ----------------------------------------------------
+    def map(self, f):
+        """Map each value through ``f``."""
+        def _map(k, v):
+            yield k, f(v)
+        return self._add_map(_map)
+
+    def map_values(self, f):
+        """Map the second element of two-tuple values."""
+        def _map_values(k, v):
+            yield k, (v[0], f(v[1]))
+        return self._add_map(_map_values)
+
+    def map_keys(self, f):
+        """Map the first element of two-tuple values."""
+        def _map_keys(k, v):
+            yield k, (f(v[0]), v[1])
+        return self._add_map(_map_keys)
+
+    def prefix(self, f):
+        """value -> (f(value), value)."""
+        def _map_prefix(k, v):
+            yield k, (f(v), v)
+        return self._add_map(_map_prefix)
+
+    def suffix(self, f):
+        """value -> (value, f(value))."""
+        def _map_suffix(k, v):
+            yield k, (v, f(v))
+        return self._add_map(_map_suffix)
+
+    def filter(self, f):
+        """Keep values where predicate holds."""
+        def _filter(k, v):
+            if f(v):
+                yield k, v
+        return self._add_map(_filter)
+
+    def flat_map(self, f):
+        """Map values to iterables and flatten."""
+        def _flat_map(k, v):
+            for vi in f(v):
+                yield k, vi
+        return self._add_map(_flat_map)
+
+    def sample(self, prob):
+        """Uniformly keep ``prob`` of records."""
+        assert 0 <= prob <= 1.0
+
+        def _sample(k, v):
+            if _get_rand().random() < prob:
+                yield k, v
+        return self._add_map(_sample)
+
+    def inspect(self, prefix="", exit=False):
+        """Print records as they stream through (debug passthrough)."""
+        def _inspect(k, v):
+            print("{}: {}".format(prefix, v))
+            yield k, v
+
+        ins = self._add_map(_inspect)
+        if exit:
+            ins.run()
+            sys.exit(0)
+        return ins
+
+    # -- grouping ----------------------------------------------------------
+    def group_by(self, key, vf=lambda x: x):
+        """General (non-associative) grouping; returns PReduce."""
+        def _group_by(_key, value):
+            yield key(value), vf(value)
+        pm = self._add_map(_group_by).checkpoint()
+        return PReduce(pm.source, pm.pmer)
+
+    def a_group_by(self, key, vf=lambda x: x):
+        """Associative grouping: enables map-side combining before the
+        shuffle (no checkpoint until the binop is known)."""
+        def _a_group_by(_key, value):
+            yield key(value), vf(value)
+        pm = self._add_map(_a_group_by)
+        return ARReduce(pm)
+
+    def fold_by(self, key, binop, value=lambda x: x, **options):
+        """Shortcut for ``a_group_by(key, value).reduce(binop)``."""
+        return self.a_group_by(key, value).reduce(binop, **options)
+
+    def sort_by(self, key, **options):
+        """Globally sort values by a key function (results merge key-sorted)."""
+        def _sort_by(_key, value):
+            yield key(value), value
+        return self._add_map(_sort_by).checkpoint(options=options)
+
+    def count(self, key=lambda x: x, **options):
+        """Count values per key — compiles to a device segment-sum."""
+        return self.a_group_by(key, lambda v: 1).reduce(segment.SUM, **options)
+
+    def mean(self, key=lambda x: 1, value=lambda x: x, **options):
+        """Per-key mean via (sum, count) pair folding."""
+        def _mean_binop(x, y):
+            return x[0] + y[0], x[1] + y[1]
+
+        def _average(x):
+            return (x[0], x[1][0] / float(x[1][1]))
+
+        return (self.a_group_by(key, lambda v: (value(v), 1))
+                .reduce(_mean_binop, **options)
+                .map(_average))
+
+    def len(self):
+        """Count all items in the collection.  With no pending per-record ops
+        the map side uses a vectorized record counter (newline counting on
+        raw text chunks); semantics are identical either way."""
+        def _map_count(items):
+            count = 0
+            for _ in items:
+                count += 1
+            yield 1, count
+
+        def _reduce_count(groups):
+            count = 0
+            not_empty = False
+            for _, counts in groups:
+                not_empty = True
+                for c in counts:
+                    count += c
+            if not_empty:
+                yield 1, count
+
+        if not self.agg:
+            from .ops.text import CountRecords
+            head = self.custom_mapper(CountRecords())
+        else:
+            head = self.partition_map(_map_count)
+        return (head
+                .partition_reduce(_reduce_count)
+                .map(lambda x: x[1]))
+
+    def topk(self, k, value=None):
+        """Top-k values by a comparable key (per-partition heaps then a
+        global heap merge)."""
+        import heapq
+
+        if value is None:
+            value = lambda x: x  # noqa: E731
+
+        def map_topk(it):
+            heap = []
+            for x in it:
+                heapq.heappush(heap, (value(x), x))
+                if len(heap) > k:
+                    heapq.heappop(heap)
+            return ((1, x) for x in heap)
+
+        def reduce_topk(it):
+            counts = (v for _k, vit in it for v in vit)
+            for _count, x in heapq.nlargest(k, counts):
+                yield x, 1
+
+        return (self.partition_map(map_topk)
+                .partition_reduce(reduce_topk)
+                .map(lambda x: x[0]))
+
+    # -- custom operators --------------------------------------------------
+    def custom_mapper(self, mapper, name=None, **options):
+        """Install a user Mapper instance (low-level; does not fuse)."""
+        if isinstance(mapper, Streamable):
+            return self._add_mapper(mapper)
+        assert isinstance(mapper, Mapper)
+        me = self.checkpoint()
+        source, pmer = me.pmer._add_mapper([me.source], mapper, options=options)
+        return PMap(source, pmer)
+
+    def custom_reducer(self, reducer, name=None, **options):
+        """Install a user Reducer instance (low-level)."""
+        assert isinstance(reducer, Reducer)
+        me = self.checkpoint(force=True)
+        source, pmer = me.pmer._add_reducer([me.source], reducer,
+                                            options=options)
+        return PMap(source, pmer)
+
+    def partition_map(self, f, **options):
+        """Map a whole partition's value iterator (runs on empty partitions)."""
+        return self.custom_mapper(StreamMapper(f), **options)
+
+    def partition_reduce(self, f):
+        """Reduce a whole partition's group iterator (runs on empty
+        partitions)."""
+        return self.custom_reducer(StreamReducer(f))
+
+    # -- two-source ops ----------------------------------------------------
+    def join(self, other):
+        """Co-partitioned join with another collection; returns PJoin."""
+        assert isinstance(other, PBase)
+        me = self.checkpoint(True)
+        if isinstance(other, PMap):
+            other = other.checkpoint(True)
+        pmer = Dampr(me.pmer.graph.union(other.pmer.graph))
+        return PJoin(me.source, pmer, other.source)
+
+    def cross_right(self, other, cross, memory=False):
+        """Map-side cross product, loop order right-major."""
+        assert isinstance(other, PMap)
+        return other.cross_left(self, lambda xi, yi: cross(yi, xi), memory)
+
+    def cross_left(self, other, cross, memory=False, **options):
+        """Map-side cross product (broadcast join).  ``memory=True`` pins the
+        replicated side in RAM."""
+        def _cross(k1, v1, k2, v2):
+            yield k1, cross(v2, v1)
+
+        me = self.checkpoint()
+        other = other.checkpoint()
+        pmer = Dampr(me.pmer.graph.union(other.pmer.graph))
+        source, pmer = pmer._add_mapper(
+            [other.source, me.source], MapCrossJoin(_cross, cache=memory),
+            combiner=None, options=options)
+        return PMap(source, pmer)
+
+    def cross_set(self, other, cross, agg=None, **options):
+        """Load the whole other side through ``agg`` and pass it to every
+        record."""
+        def _cross(k1, v1, right):
+            yield k1, cross(v1, right)
+
+        if agg is None:
+            agg = list
+
+        def _aggregate(d):
+            return agg(v for _k, v in d)
+
+        me = self.checkpoint()
+        other = other.checkpoint()
+        pmer = Dampr(me.pmer.graph.union(other.pmer.graph))
+        source, pmer = pmer._add_mapper(
+            [other.source, me.source], MapAllJoin(_cross, _aggregate),
+            combiner=None, options=options)
+        return PMap(source, pmer)
+
+    # -- persistence -------------------------------------------------------
+    def cached(self, **options):
+        """Materialize and pin this stage's output in RAM (never spills)."""
+        options["memory"] = True
+        return self.checkpoint(force=True, options=options)
+
+    def sink(self, path):
+        """Write each value as a text line into part-files under ``path``
+        (durable — exempt from cleanup)."""
+        aggs = [Map(_identity)] if len(self.agg) == 0 else self.agg[:]
+        source, pmer = self.pmer._add_sink([self.source], fuse(aggs),
+                                           path=path, options=None)
+        return PMap(source, pmer)
+
+    def sink_tsv(self, path):
+        """Tab-join tuple values, then sink."""
+        return self.map(lambda x: u"\t".join(str(p) for p in x)).sink(path)
+
+    def sink_json(self, path):
+        """JSON-serialize values line-delimited, then sink."""
+        return self.map(json.dumps).sink(path)
+
+
+class ARReduce(object):
+    """Associative reduce handle: folds map-side, shuffles compacted partials,
+    folds again reduce-side (reference dampr.py:654-709; the decomposition is
+    the reference's PartialReduceCombiner pipeline restated as segment
+    kernels — see SURVEY §3.3)."""
+
+    def __init__(self, pmap):
+        self.pmap = pmap
+
+    def reduce(self, binop, reduce_buffer=1000, **options):
+        """Reduce groups with an associative binop.  ``reduce_buffer`` is
+        accepted for API parity; block-size accounting replaces it."""
+        op = segment.as_assoc_op(binop)
+        options.update({"binop": op, "reduce_buffer": reduce_buffer})
+        pm = self.pmap.checkpoint(
+            True, combiner=PartialReduceCombiner(op), options=options)
+        new_source, pmer = pm.pmer._add_reducer(
+            [pm.source], AssocFoldReducer(op), options=options)
+        return PMap(new_source, pmer)
+
+    def first(self, **options):
+        """First value seen per key."""
+        return self.reduce(segment.FIRST, **options)
+
+    def sum(self, **options):
+        """Sum values per key — device segment-sum end-to-end for numeric
+        values."""
+        return self.reduce(segment.SUM, **options)
+
+
+class PReduce(PBase):
+    """General grouped collection (post group_by)."""
+
+    def reduce(self, f):
+        """``f(key, value_iter) -> value`` per group."""
+        new_source, pmer = self.pmer._add_reducer([self.source], KeyedReduce(f))
+        return PMap(new_source, pmer)
+
+    def unique(self, key=lambda x: x):
+        """Distinct values per group (first occurrence wins)."""
+        def _uniq(k, it):
+            seen = set()
+            agg = []
+            for v in it:
+                fv = key(v)
+                if fv not in seen:
+                    seen.add(fv)
+                    agg.append(v)
+            return agg
+
+        return self.reduce(_uniq)
+
+    def join(self, other):
+        """Join grouped data with another collection."""
+        assert isinstance(other, PBase)
+        if isinstance(other, PMap):
+            other = other.checkpoint(True)
+        pmer = Dampr(self.pmer.graph.union(other.pmer.graph))
+        return PJoin(self.source, pmer, other.source)
+
+    def partition_reduce(self, f):
+        """Whole-partition reduce over the grouped stream."""
+        new_source, pmer = self.pmer._add_reducer([self.source],
+                                                  StreamReducer(f))
+        return PMap(new_source, pmer)
+
+
+class PJoin(PBase):
+    """Join handle over two co-partitioned grouped sources."""
+
+    def __init__(self, source, pmer, right):
+        super(PJoin, self).__init__(source, pmer)
+        self.right = right
+
+    def run(self, name=None, **kwargs):
+        return self.reduce(lambda l, r: (list(l), list(r))).run(name, **kwargs)
+
+    def reduce(self, aggregate, many=False):
+        """Inner join: ``aggregate(left_iter, right_iter)`` per matched key;
+        ``many=True`` flattens the result into separate records."""
+        def _reduce(k, left, right):
+            return aggregate(left, right)
+
+        source, pmer = self.pmer._add_reducer(
+            [self.source, self.right], KeyedInnerJoin(_reduce, many))
+        return PMap(source, pmer)
+
+    def left_reduce(self, aggregate):
+        """Left join: missing right keys see an empty iterator."""
+        def _reduce(k, left, right):
+            return aggregate(left, right)
+
+        source, pmer = self.pmer._add_reducer(
+            [self.source, self.right], KeyedLeftJoin(_reduce))
+        return PMap(source, pmer)
+
+    def outer_reduce(self, aggregate):
+        """Full outer join: whichever side is missing a key sees an empty
+        iterator.  (New capability — the reference defines but never exposes
+        an outer join, and its implementation is broken: base.py:355, 366.)"""
+        def _reduce(k, left, right):
+            return aggregate(left, right)
+
+        source, pmer = self.pmer._add_reducer(
+            [self.source, self.right], KeyedOuterJoin(_reduce))
+        return PMap(source, pmer)
+
+
+class Dampr(object):
+    """Entrypoint: constructors for sources + the multi-output run."""
+
+    def __init__(self, graph=None, runner=None):
+        self.graph = Graph() if graph is None else graph
+        self.runner = MTRunner if runner is None else runner
+
+    @classmethod
+    def memory(cls, items, partitions=50):
+        """In-memory collection (keys = positions)."""
+        mi = MemoryInput(list(enumerate(items)), partitions)
+        source, ng = Graph().add_input(mi)
+        return PMap(source, cls(ng))
+
+    @classmethod
+    def read_input(cls, *datasets):
+        """Read from datasets / chunkers directly."""
+        if len(datasets) == 1:
+            ds = datasets[0]
+        else:
+            ds = CatDataset(list(datasets))
+        source, ng = Graph().add_input(ds)
+        return PMap(source, cls(ng))
+
+    @classmethod
+    def text(cls, fname, chunk_size=16 * 1024 ** 2, followlinks=False):
+        """Newline-delimited text from a file/dir/glob, split into byte-range
+        chunks."""
+        return cls.read_input(PathInput(fname, chunk_size, followlinks))
+
+    @classmethod
+    def json(cls, *args, **kwargs):
+        """Line-delimited JSON records."""
+        return cls.text(*args, **kwargs).map(json.loads)
+
+    @classmethod
+    def urls(cls, urls, skip_on_error=True):
+        """Fetch newline-delimited text over HTTP, one chunk per URL."""
+        return cls.read_input(UrlsInput(urls, skip_on_error))
+
+    @classmethod
+    def from_dataset(cls, dataset):
+        """Wrap raw stage outputs / custom Dataset subclasses as an input."""
+        assert isinstance(dataset, Chunker)
+        source, ng = Graph().add_input(dataset)
+        return PMap(source, cls(ng))
+
+    @classmethod
+    def run(cls, *pmers, **kwargs):
+        """Run several graphs in one pass; shared prefixes compute once.
+        Returns one ValueEmitter per argument."""
+        assert len(pmers) > 0, "Need at least one graph to run!"
+        sources = []
+        graph = None
+        pmer = None
+        for i, pmer in enumerate(pmers):
+            if isinstance(pmer, PMap):
+                pmer = pmer.checkpoint()
+            elif isinstance(pmer, PJoin):
+                pmer = pmer.reduce(lambda l, r: (list(l), list(r)))
+            graph = pmer.pmer.graph if i == 0 else pmer.pmer.graph.union(graph)
+            sources.append(pmer.source)
+
+        name = kwargs.pop("name", "dampr/{}".format(random.random()))
+        runner = pmer.pmer.runner(name, graph, **kwargs)
+        ds = runner.run(sources)
+        stats = [s.as_dict() for s in getattr(runner, "stats", [])]
+        emitters = []
+        for d in ds:
+            em = ValueEmitter(d)
+            em.stats = stats
+            emitters.append(em)
+        return emitters
+
+    # -- graph builders (value semantics) ----------------------------------
+    def _add_mapper(self, *args, **kwargs):
+        output, ng = self.graph.add_mapper(*args, **kwargs)
+        return output, Dampr(ng)
+
+    def _add_reducer(self, *args, **kwargs):
+        output, ng = self.graph.add_reducer(*args, **kwargs)
+        return output, Dampr(ng)
+
+    def _add_sink(self, *args, **kwargs):
+        output, ng = self.graph.add_sink(*args, **kwargs)
+        return output, Dampr(ng)
+
+
+# Per-thread RNG for sample(): jobs run on threads, and a shared Random would
+# serialize them on its lock and interleave streams nondeterministically.
+_RAND_LOCAL = threading.local()
+
+
+def _get_rand():
+    r = getattr(_RAND_LOCAL, "rand", None)
+    if r is None:
+        r = random.Random(time.time() + threading.get_ident())
+        _RAND_LOCAL.rand = r
+    return r
+
+
+def setup_logging(debug=False):
+    level = logging.DEBUG if debug else logging.INFO
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s")

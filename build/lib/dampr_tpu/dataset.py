@@ -1,0 +1,265 @@
+"""Read-side datasets: the streaming record sources stages consume.
+
+Parity surface: reference dampr/dataset.py:420-629 (``Chunker``/``Dataset``
+interfaces, ``TextLineDataset`` byte-range reading with boundary repair,
+``GzipLineDataset``, ``MemoryDataset``, ``CatDataset``, ``StreamDataset``,
+``EmptyDataset``).  The write side is completely different: instead of pickled
+row streams we materialize columnar :class:`~dampr_tpu.blocks.Block` batches
+(see storage.py for the spill tier), so the "dataset" here is mostly the *tap*
+layer feeding host records into blocks, plus thin views over materialized
+blocks.
+
+Record model: every dataset yields ``(key, value)`` pairs.  Text taps yield
+``(byte_offset, line)`` — the offset keys make map-only pipelines emit in input
+order after the key-sorted final merge (reference semantics).
+"""
+
+import gzip
+import itertools
+import os
+
+from .blocks import Block
+
+
+class Chunker(object):
+    """Splittable input: yields independent Datasets to map over in parallel
+    (reference dataset.py:420-422)."""
+
+    def chunks(self):
+        raise NotImplementedError()
+
+
+class Dataset(Chunker):
+    """A stream of (key, value) records (reference dataset.py:425-442)."""
+
+    def read(self):
+        raise NotImplementedError()
+
+    def grouped_read(self):
+        """Group consecutive equal keys (meaningful on key-sorted data)."""
+        for key, group in itertools.groupby(self.read(), key=lambda kv: kv[0]):
+            yield key, (kv[1] for kv in group)
+
+    def delete(self):
+        pass
+
+    def __iter__(self):
+        return self.read()
+
+    def chunks(self):
+        yield self
+
+
+class EmptyDataset(Dataset):
+    def read(self):
+        return iter(())
+
+
+class BlockDataset(Dataset):
+    """View over a list of materialized block refs (see storage.BlockRef).
+
+    This is the dataset form of a stage-output partition; blocks may be
+    RAM-resident or spilled — ``iter_blocks`` materializes transparently.
+    """
+
+    def __init__(self, refs):
+        self.refs = list(refs)
+
+    def iter_blocks(self):
+        for r in self.refs:
+            yield r.get() if hasattr(r, "get") else r
+
+    def read(self):
+        for blk in self.iter_blocks():
+            for kv in blk.iter_pairs():
+                yield kv
+
+    def concat(self):
+        return Block.concat(list(self.iter_blocks()))
+
+    def delete(self):
+        for r in self.refs:
+            if hasattr(r, "delete"):
+                r.delete()
+        self.refs = []
+
+
+class MemoryDataset(Dataset):
+    """In-memory list of (k, v) pairs (reference dataset.py:590-610)."""
+
+    def __init__(self, kvs):
+        self.kvs = kvs
+
+    def read(self):
+        return iter(self.kvs)
+
+
+class StreamDataset(Dataset):
+    """Single-shot iterator wrapper (reference dataset.py:612-620)."""
+
+    def __init__(self, it):
+        self.it = it
+
+    def read(self):
+        return self.it
+
+
+class CatDataset(Dataset):
+    """Concatenation of several datasets (reference dataset.py:550-565)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def read(self):
+        for ds in self.datasets:
+            for kv in ds.read():
+                yield kv
+
+    def chunks(self):
+        for ds in self.datasets:
+            yield ds
+
+    def delete(self):
+        for ds in self.datasets:
+            ds.delete()
+
+
+class TextLineDataset(Dataset):
+    """Byte-range slice of a newline-delimited text file.
+
+    Chunk-boundary contract (mirrors reference dataset.py:452-482, restated in
+    byte terms): a chunk ``[start, end)`` with ``start > 0`` skips everything up
+    to and including the first newline at-or-after ``start``; every chunk keeps
+    reading through the line that crosses ``end``.  Together the two rules read
+    each line exactly once across adjacent chunks, and splitting at arbitrary
+    byte offsets is UTF-8 safe because ``\\n`` can never occur inside a
+    multi-byte sequence (this *is* the boundary repair — no alignment probing
+    needed when line-splitting happens on raw bytes).
+
+    Keys are byte offsets of each line's first byte.
+    """
+
+    def __init__(self, path, start=0, end=None):
+        self.path = path
+        self.start = start
+        self.end = end
+
+    def read(self):
+        with open(self.path, "rb") as f:
+            pos = self.start
+            if self.start > 0:
+                f.seek(self.start)
+                pos += len(f.readline())
+                if self.end is not None and pos > self.end:
+                    # The skipped partial line already crossed our end: every
+                    # remaining line belongs to a later chunk.  (A line longer
+                    # than chunk_size would otherwise be double-read — a bug
+                    # present in the reference, not replicated.)
+                    return
+            for raw in f:
+                yield pos, raw.decode("utf-8").rstrip("\n")
+                pos += len(raw)
+                if self.end is not None and pos > self.end:
+                    break
+
+    def read_bytes(self):
+        """The chunk's owned bytes as one buffer (for vectorized block
+        mappers).  Exactly the bytes of the lines ``read()`` yields: skip
+        through the first newline when start > 0, extend through the line
+        that crosses ``end``."""
+        with open(self.path, "rb") as f:
+            real_start = self.start
+            if self.start > 0:
+                f.seek(self.start)
+                f.readline()
+                real_start = f.tell()
+            if self.end is None:
+                f.seek(real_start)
+                return f.read()
+            if real_start > self.end:
+                return b""
+            f.seek(self.end)
+            f.readline()
+            real_end = f.tell()
+            f.seek(real_start)
+            return f.read(real_end - real_start)
+
+    def __repr__(self):
+        return "Text[path={},start={},end={}]".format(
+            self.path, self.start, self.end)
+
+
+class GzipLineDataset(Dataset):
+    """A .gz text file as a single unsplittable chunk (reference
+    dataset.py:484-499; unsplittable per inputs.py:49-52)."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def read(self):
+        with gzip.open(self.path, "rb") as f:
+            pos = 0
+            for raw in f:
+                yield pos, raw.decode("utf-8").rstrip("\n")
+                pos += len(raw)
+
+    def read_bytes(self):
+        with gzip.open(self.path, "rb") as f:
+            return f.read()
+
+    def iter_byte_blocks(self, block_size=4 * 1024 ** 2):
+        """Stream decompressed bytes in bounded blocks (so consumers that
+        only scan — record counting — never hold the whole expansion)."""
+        with gzip.open(self.path, "rb") as f:
+            while True:
+                b = f.read(block_size)
+                if not b:
+                    return
+                yield b
+
+    def __repr__(self):
+        return "GzipFile[path={}]".format(self.path)
+
+
+class SinkDataset(Dataset):
+    """Reads back a sink's part-file as (offset, line) — durable text written
+    by a GSink stage (reference keeps sink outputs on disk, exempt from
+    cleanup: runner.py:194-197)."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def read(self):
+        return TextLineDataset(self.path).read()
+
+    def delete(self):
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+
+class OrderKey(object):
+    """Total-order wrapper for record keys: native comparison when types are
+    compatible, deterministic type-name ordering otherwise.  The reference
+    raises TypeError from heapq.merge on mixed-type keys (Py3); we keep mixed
+    outputs readable with a stable cross-type order instead."""
+
+    __slots__ = ("k",)
+
+    def __init__(self, k):
+        self.k = k
+
+    def __lt__(self, other):
+        a, b = self.k, other.k
+        try:
+            return bool(a < b)
+        except TypeError:
+            return type(a).__name__ < type(b).__name__
+
+
+def merged_read(datasets):
+    """K-way merge of key-sorted datasets by key (reference MergeDataset,
+    dataset.py:567-588)."""
+    import heapq
+
+    its = [ds.read() for ds in datasets]
+    return heapq.merge(*its, key=lambda kv: OrderKey(kv[0]))

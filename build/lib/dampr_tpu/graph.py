@@ -1,0 +1,161 @@
+"""Logical lazy DAG.
+
+Parity surface: reference dampr/runner.py:17-135 — copy-on-write ``Graph`` whose
+``add_*`` methods return ``(Source, new_graph)``; ``Source`` identity comes from a
+global counter; ``union`` merges two graphs deduping shared stages; stages are kept in
+a linear list in construction order (order *is* the schedule — reference
+runner.py:178).
+
+These semantics are engine-independent and proven by the reference conformance tests,
+so they transfer conceptually unchanged; the implementation below is written fresh.
+The execution engine that consumes this graph is completely different (see
+runner.py: stages lower to JAX programs instead of forked workers).
+"""
+
+import itertools
+
+
+class Source(object):
+    """Handle naming the output of one stage (reference runner.py:17-33).
+
+    Identity is a process-global monotonically increasing id so sources are
+    hashable, ordered, and unique across graph copies.
+    """
+
+    _ids = itertools.count()
+
+    __slots__ = ("sid",)
+
+    def __init__(self):
+        self.sid = next(Source._ids)
+
+    def __hash__(self):
+        return hash(self.sid)
+
+    def __eq__(self, other):
+        return isinstance(other, Source) and self.sid == other.sid
+
+    def __lt__(self, other):
+        return self.sid < other.sid
+
+    def __repr__(self):
+        return "Source[{}]".format(self.sid)
+
+
+class StageNode(object):
+    """Base for graph stage nodes; `options` carries per-op overrides
+    (n_maps/n_reducers/memory/binop — reference runner.py:285/331)."""
+
+    __slots__ = ("inputs", "output", "options")
+
+    def __init__(self, inputs, output, options=None):
+        self.inputs = list(inputs)
+        self.output = output
+        self.options = options or {}
+
+
+class GInput(StageNode):
+    """Pseudo-node binding a Source to an input tap (reference keeps taps in
+    Graph.inputs, runner.py:75-89; we make it explicit for uniform walking)."""
+
+    __slots__ = ("tap",)
+
+    def __init__(self, tap, output):
+        super(GInput, self).__init__([], output)
+        self.tap = tap
+
+    def __repr__(self):
+        return "GInput[{} <- {!r}]".format(self.output, self.tap)
+
+
+class GMap(StageNode):
+    """Map stage: fused mapper (+ optional combiner/shuffler) — reference
+    runner.py:35-47."""
+
+    __slots__ = ("mapper", "combiner", "shuffler")
+
+    def __init__(self, inputs, output, mapper, combiner=None, shuffler=None,
+                 options=None):
+        super(GMap, self).__init__(inputs, output, options)
+        self.mapper = mapper
+        self.combiner = combiner
+        self.shuffler = shuffler
+
+    def __repr__(self):
+        return "GMap[{} <- {}]".format(self.output, self.inputs)
+
+
+class GReduce(StageNode):
+    """Reduce stage over co-partitioned inputs — reference runner.py:49-59."""
+
+    __slots__ = ("reducer",)
+
+    def __init__(self, inputs, output, reducer, options=None):
+        super(GReduce, self).__init__(inputs, output, options)
+        self.reducer = reducer
+
+    def __repr__(self):
+        return "GReduce[{} <- {}]".format(self.output, self.inputs)
+
+
+class GSink(StageNode):
+    """Durable output stage — reference runner.py:61-71."""
+
+    __slots__ = ("sinker", "path")
+
+    def __init__(self, inputs, output, sinker, path, options=None):
+        super(GSink, self).__init__(inputs, output, options)
+        self.sinker = sinker
+        self.path = path
+
+    def __repr__(self):
+        return "GSink[{} <- {} -> {}]".format(self.output, self.inputs, self.path)
+
+
+class Graph(object):
+    """Copy-on-write stage list (reference runner.py:74-135).
+
+    ``stages`` is an ordered list of StageNodes; construction order is the
+    schedule.  Every ``add_*`` returns ``(Source, Graph)`` with the receiver
+    unmodified, so handles are freely shareable and branches can diverge.
+    """
+
+    def __init__(self, stages=None):
+        self.stages = list(stages) if stages else []
+
+    # -- builders ----------------------------------------------------------
+    def _extend(self, node):
+        g = Graph(self.stages)
+        g.stages.append(node)
+        return node.output, g
+
+    def add_input(self, tap):
+        return self._extend(GInput(tap, Source()))
+
+    def add_mapper(self, inputs, mapper, combiner=None, shuffler=None,
+                   name=None, options=None):
+        return self._extend(
+            GMap(inputs, Source(), mapper, combiner, shuffler, options))
+
+    def add_reducer(self, inputs, reducer, name=None, options=None):
+        return self._extend(GReduce(inputs, Source(), reducer, options))
+
+    def add_sink(self, inputs, sinker, path, name=None, options=None):
+        return self._extend(GSink(inputs, Source(), sinker, path, options))
+
+    # -- merging -----------------------------------------------------------
+    def union(self, other):
+        """Merge two graphs, deduping shared stage nodes by identity of their
+        output Source (reference runner.py:127-135).  Shared prefixes — the same
+        node object reachable from both handles — appear once; relative order is
+        preserved (stable by first appearance, self first)."""
+        seen = set()
+        stages = []
+        for node in itertools.chain(self.stages, other.stages):
+            if node.output not in seen:
+                seen.add(node.output)
+                stages.append(node)
+        return Graph(stages)
+
+    def __repr__(self):
+        return "Graph[{} stages]".format(len(self.stages))

@@ -1,0 +1,120 @@
+"""Native host codec: builds and binds the C++ tokenizer via ctypes.
+
+The shared object compiles on first use (g++ -O3, ~1s) and is cached next to
+the source; set DAMPR_TPU_NATIVE=0 to force the pure-numpy fallback.  The
+binding is ctypes on purpose — no pybind11 in the image, and the interface is
+four flat arrays, exactly what ctypes does well.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("dampr_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tokenizer.cpp")
+_SO = os.path.join(_HERE, "_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd + ["-march=native"], check=True,
+                       capture_output=True)
+    except (subprocess.CalledProcessError, OSError):
+        subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DAMPR_TPU_NATIVE", "1") in ("0", "false"):
+            return None
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            fn = lib.dampr_tokenize_hash
+            fn.restype = ctypes.c_long
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            fc = lib.dampr_token_counts
+            fc.restype = ctypes.c_long
+            fc.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            _lib = lib
+        except Exception as exc:  # noqa: BLE001 - any failure -> numpy path
+            log.warning("native tokenizer unavailable (%s); using numpy", exc)
+            _lib = None
+    return _lib
+
+
+def tokenize_hash(buf, mode, lower, want_line_ids=False):
+    """One native pass: (starts, lens, h1, h2[, line_ids]) for a uint8 buffer.
+    Returns None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(buf)
+    cap = n // 2 + 1
+    starts = np.empty(cap, dtype=np.int64)
+    lens = np.empty(cap, dtype=np.int32)
+    h1 = np.empty(cap, dtype=np.uint32)
+    h2 = np.empty(cap, dtype=np.uint32)
+    line_ids = np.empty(cap, dtype=np.int64) if want_line_ids else None
+    buf = np.ascontiguousarray(buf)
+    count = lib.dampr_tokenize_hash(
+        buf.ctypes.data, n, int(mode), int(lower),
+        starts.ctypes.data, lens.ctypes.data,
+        h1.ctypes.data, h2.ctypes.data,
+        line_ids.ctypes.data if want_line_ids else None)
+    out = (starts[:count], lens[:count], h1[:count], h2[:count])
+    if want_line_ids:
+        out = out + (line_ids[:count],)
+    return out
+
+
+def token_counts(buf, mode, lower, dedup_per_line):
+    """Fused native tokenize+hash+count: one pass, no sort.  Returns
+    (h1, h2, counts, rep_starts, rep_lens) over distinct tokens, or None when
+    the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(buf)
+    cap = n // 2 + 1
+    h1 = np.empty(cap, dtype=np.uint32)
+    h2 = np.empty(cap, dtype=np.uint32)
+    counts = np.empty(cap, dtype=np.int64)
+    starts = np.empty(cap, dtype=np.int64)
+    lens = np.empty(cap, dtype=np.int32)
+    buf = np.ascontiguousarray(buf)
+    k = lib.dampr_token_counts(
+        buf.ctypes.data, n, int(mode), int(lower), int(dedup_per_line),
+        h1.ctypes.data, h2.ctypes.data, counts.ctypes.data,
+        starts.ctypes.data, lens.ctypes.data)
+    if k < 0:
+        return None
+    return h1[:k], h2[:k], counts[:k], starts[:k], lens[:k]

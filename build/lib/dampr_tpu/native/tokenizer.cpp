@@ -1,0 +1,222 @@
+// Host-side record codec: single-pass tokenizer + dual-lane FNV-1a hasher.
+//
+// The TPU compute path (XLA/segment kernels) starts from token hash lanes;
+// producing those lanes from raw text is host work that pure numpy does in
+// several passes (class lookup, boundary scan, padded gather, column-wise
+// FNV).  This C++ pass fuses all of it: one walk over the chunk buffer emits
+// token offsets, lengths, and both hash lanes.  This is the framework's
+// native "host I/O layer" component (SURVEY §7.2): the reference is pure
+// Python end-to-end, so there is no reference counterpart to mirror — the
+// design target is simply to outrun the TPU feed.
+//
+// Hash compatibility: lanes MUST match ops/hashing.py exactly
+// (_FNV_OFFSET1/2, _FNV_PRIME1/2 over utf-8 bytes) so tokens group with
+// equal Python-string keys everywhere in the engine.
+//
+// Build: g++ -O3 -march=native -shared -fPIC tokenizer.cpp -o _native.so
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Token classification modes (keep in sync with dampr_tpu/ops/text.py):
+//   mode 0: whitespace-delimited (str.split semantics, ASCII whitespace)
+//   mode 1: word characters [0-9A-Za-z_] + bytes >= 128 (re [^\w]+ on ASCII)
+// Table-driven: one L1-resident lookup per byte beats the range-compare
+// chain in the hot scan.
+struct ClassTables {
+    bool tok[2][256];
+    uint8_t fold[2][256];  // [lower?][byte] -> case-folded byte
+    ClassTables() {
+        for (int b = 0; b < 256; ++b) {
+            tok[0][b] = !(b == ' ' || b == '\t' || b == '\n' || b == '\r' ||
+                          b == '\v' || b == '\f');
+            tok[1][b] = (b >= '0' && b <= '9') || (b >= 'A' && b <= 'Z') ||
+                        (b >= 'a' && b <= 'z') || b == '_' || b >= 128;
+            fold[0][b] = (uint8_t)b;
+            fold[1][b] = (b >= 'A' && b <= 'Z') ? (uint8_t)(b + 32)
+                                                : (uint8_t)b;
+        }
+    }
+};
+static const ClassTables kTables;
+
+// Single pass: tokenize + hash + (optional) lowercase folding into the hash.
+// Returns the number of tokens found.  Output arrays must hold at least
+// n/2 + 1 entries (the worst case: alternating token/separator bytes).
+// line_ids receives the 0-based line index of each token (newlines counted
+// in the raw buffer) — pass nullptr to skip.
+long dampr_tokenize_hash(const uint8_t* buf, long n, int mode, int lower,
+                         int64_t* starts, int32_t* lens,
+                         uint32_t* h1_out, uint32_t* h2_out,
+                         int64_t* line_ids) {
+    const uint32_t OFF1 = 2166136261u, OFF2 = 0x9747B28Cu;
+    const uint32_t P1 = 16777619u, P2 = 0x85EBCA6Bu;
+
+    const uint8_t* fold = kTables.fold[lower ? 1 : 0];
+    const bool* tokt = kTables.tok[mode ? 1 : 0];
+    long count = 0;
+    long i = 0;
+    int64_t line = 0;
+    while (i < n) {
+        uint8_t b = buf[i];
+        if (b == '\n') { ++line; ++i; continue; }
+        if (!tokt[b]) { ++i; continue; }
+        // token run
+        long s = i;
+        uint32_t h1 = OFF1, h2 = OFF2;
+        int64_t tok_line = line;
+        do {
+            uint8_t c = fold[buf[i]];
+            h1 = (h1 ^ c) * P1;
+            h2 = (h2 ^ c) * P2;
+            ++i;
+        } while (i < n && tokt[buf[i]]);
+        starts[count] = s;
+        lens[count] = (int32_t)(i - s);
+        h1_out[count] = h1;
+        h2_out[count] = h2;
+        if (line_ids) line_ids[count] = tok_line;
+        ++count;
+    }
+    return count;
+}
+
+// Fused tokenize + hash + count: one pass over the buffer feeding an
+// open-addressing table keyed on the 64-bit hash pair *verified by byte
+// comparison* — a probe hit requires equal hashes AND equal token bytes
+// (case-folded when lower is set), so distinct tokens colliding in all 64
+// hash bits occupy separate slots and are never silently merged.  (They then
+// emit separate entries sharing (h1, h2); the engine's sort-based grouping
+// repairs exactly that shape downstream by comparing real keys.)
+//
+// Emits one entry per distinct token: (h1, h2, count, representative
+// offset/len).  With dedup_per_line != 0 a token increments at most once per
+// newline-delimited line (document frequency — the reference TF-IDF
+// benchmark's map+count, tf-idf-dampr.py:13-15).
+//
+// Returns the number of distinct tokens (<= out array capacity n/2+1), or -1
+// on allocation failure.
+
+// Byte equality of the tails past the inline 8-byte prefix (folded when
+// lower is set).  Only runs for tokens longer than 8 bytes whose hashes,
+// length, and prefix all matched — rare, so the random buffer access it
+// costs is off the hot path.
+static inline bool tail_eq(const uint8_t* buf, int64_t a, int64_t b,
+                           int32_t len, int lower) {
+    if (!lower) return memcmp(buf + a + 8, buf + b + 8, (size_t)(len - 8)) == 0;
+    for (int32_t i = 8; i < len; ++i) {
+        uint8_t x = buf[a + i], y = buf[b + i];
+        if (x >= 'A' && x <= 'Z') x += 32;
+        if (y >= 'A' && y <= 'Z') y += 32;
+        if (x != y) return false;
+    }
+    return true;
+}
+long dampr_token_counts(const uint8_t* buf, long n, int mode, int lower,
+                        int dedup_per_line,
+                        uint32_t* out_h1, uint32_t* out_h2,
+                        int64_t* out_count,
+                        int64_t* out_start, int32_t* out_len) {
+    const uint32_t OFF1 = 2166136261u, OFF2 = 0x9747B28Cu;
+    const uint32_t P1 = 16777619u, P2 = 0x85EBCA6Bu;
+
+    struct Entry {
+        uint32_t h1, h2;
+        uint64_t prefix;    // first <=8 folded bytes, zero-padded: the
+                            // cache-local equality word for short tokens
+        int64_t count;
+        int64_t start;
+        int32_t len;
+        int64_t last_line;  // for per-line dedup; -1 = never seen
+        bool used;
+    };
+
+    long cap_tbl = 1 << 16;
+    Entry* tbl = (Entry*)calloc(cap_tbl, sizeof(Entry));
+    if (!tbl) return -1;
+    long used = 0;
+
+    const uint8_t* fold = kTables.fold[lower ? 1 : 0];
+    const bool* tokt = kTables.tok[mode ? 1 : 0];
+    long i = 0;
+    int64_t line = 0;
+    while (i < n) {
+        uint8_t b = buf[i];
+        if (b == '\n') { ++line; ++i; continue; }
+        if (!tokt[b]) { ++i; continue; }
+        long s = i;
+        uint32_t h1 = OFF1, h2 = OFF2;
+        uint64_t prefix = 0;
+        do {
+            uint8_t c = fold[buf[i]];
+            h1 = (h1 ^ c) * P1;
+            h2 = (h2 ^ c) * P2;
+            long off = i - s;
+            if (off < 8) prefix |= ((uint64_t)c) << (off * 8);
+            ++i;
+        } while (i < n && tokt[buf[i]]);
+        int32_t len = (int32_t)(i - s);
+
+        // grow at 70% load
+        if (used * 10 >= cap_tbl * 7) {
+            long ncap = cap_tbl * 2;
+            Entry* nt = (Entry*)calloc(ncap, sizeof(Entry));
+            if (!nt) { free(tbl); return -1; }
+            for (long j = 0; j < cap_tbl; ++j) {
+                if (!tbl[j].used) continue;
+                uint64_t h = ((uint64_t)tbl[j].h1 << 32) | tbl[j].h2;
+                long k = (long)(h & (uint64_t)(ncap - 1));
+                while (nt[k].used) k = (k + 1) & (ncap - 1);
+                nt[k] = tbl[j];
+            }
+            free(tbl);
+            tbl = nt;
+            cap_tbl = ncap;
+        }
+
+        uint64_t h = ((uint64_t)h1 << 32) | h2;
+        long k = (long)(h & (uint64_t)(cap_tbl - 1));
+        while (tbl[k].used &&
+               !(tbl[k].h1 == h1 && tbl[k].h2 == h2 && tbl[k].len == len &&
+                 tbl[k].prefix == prefix &&
+                 (len <= 8 || tail_eq(buf, tbl[k].start, s, len, lower))))
+            k = (k + 1) & (cap_tbl - 1);
+        if (!tbl[k].used) {
+            tbl[k].used = true;
+            tbl[k].h1 = h1;
+            tbl[k].h2 = h2;
+            tbl[k].prefix = prefix;
+            tbl[k].count = 0;
+            tbl[k].start = s;
+            tbl[k].len = len;
+            tbl[k].last_line = -1;
+            ++used;
+        }
+        if (dedup_per_line) {
+            if (tbl[k].last_line != line) {
+                tbl[k].last_line = line;
+                tbl[k].count += 1;
+            }
+        } else {
+            tbl[k].count += 1;
+        }
+    }
+
+    long out = 0;
+    for (long j = 0; j < cap_tbl; ++j) {
+        if (!tbl[j].used) continue;
+        out_h1[out] = tbl[j].h1;
+        out_h2[out] = tbl[j].h2;
+        out_count[out] = tbl[j].count;
+        out_start[out] = tbl[j].start;
+        out_len[out] = tbl[j].len;
+        ++out;
+    }
+    free(tbl);
+    return out;
+}
+
+}  // extern "C"

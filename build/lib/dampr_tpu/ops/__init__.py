@@ -1,0 +1,5 @@
+"""Device kernels for dampr_tpu: hashing, segment reduction, sort-based grouping,
+and the mesh shuffle.  Every kernel has a numpy host fallback selected by
+``settings.use_device`` / small-batch thresholds."""
+
+from .hashing import hash_keys, encode_str_keys, combine64

@@ -1,0 +1,347 @@
+"""Vectorized text kernels: raw chunk bytes -> token hash lanes -> folded
+(token, count) blocks with zero per-record Python.
+
+This is the dual-path execution SURVEY §7 plans for ("a vectorized fast path
+for recognized ops"): the reference streams every token through Python
+generators (dampr/base.py:30-33); here a 16MB text chunk becomes a uint8
+tensor, token boundaries come from vectorized class lookups, hashing runs the
+same dual-lane FNV kernel used everywhere (ops/hashing.py — so tokens group
+with equal Python-string keys), and counting is a hash-sort + segment fold.
+Token *strings* materialize only for the distinct keys that survive folding
+(vocabulary-sized, not corpus-sized).
+
+Two mappers implement the block protocol (``map_blocks``) the runner
+recognizes, each with an exact per-record fallback for inputs that don't
+expose raw bytes:
+
+- :class:`TokenCounts` — word count: (token, occurrences).
+- :class:`DocFreq` — per-line-deduplicated counts: (token, number of lines
+  containing it) — the reference TF-IDF benchmark's map+count shape
+  (benchmarks/tf-idf-dampr.py:13-15: ``flat_map(lambda x:
+  set(RX.split(x.lower()))).count()``).
+
+ASCII semantics note: 'word' mode matches ``re.split(r'[^\\w]+')`` and
+``.lower()`` byte-wise, which is exact for ASCII text; non-ASCII bytes are
+treated as word characters (utf-8 continuation bytes stay inside tokens).
+"""
+
+import numpy as np
+
+from ..base import Mapper
+from . import hashing
+
+# --- byte classification tables -------------------------------------------
+
+_WS = np.zeros(256, dtype=bool)
+for _b in b" \t\n\r\x0b\x0c":
+    _WS[_b] = True
+
+_WORD = np.zeros(256, dtype=bool)
+for _b in range(256):
+    c = chr(_b)
+    if c.isalnum() and _b < 128 or c == "_":
+        _WORD[_b] = True
+_WORD[128:] = True  # utf-8 continuation/lead bytes ride inside tokens
+
+_LOWER = np.arange(256, dtype=np.uint8)
+_LOWER[65:91] += 32  # A-Z -> a-z
+
+
+def _token_bounds(buf, mode):
+    """starts[int64], lens[int32] of maximal token runs in a uint8 buffer."""
+    if mode == "word":
+        in_tok = _WORD[buf]
+    else:
+        in_tok = ~_WS[buf]
+    if not len(buf):
+        return np.empty(0, np.int64), np.empty(0, np.int32)
+    # boundaries where in_tok changes
+    change = np.empty(len(buf) + 1, dtype=bool)
+    change[0] = in_tok[0]
+    np.not_equal(in_tok[1:], in_tok[:-1], out=change[1:-1])
+    change[-1] = in_tok[-1]
+    bounds = np.flatnonzero(change)
+    # bounds alternate start, end, start, end... beginning with a start
+    starts = bounds[0::2].astype(np.int64)
+    ends = bounds[1::2].astype(np.int64)
+    return starts, (ends - starts).astype(np.int32)
+
+
+# Tokens at most this long go through the padded-matrix unique path; longer
+# ones (rare in text) fall to a Python dict so the matrix stays bounded.
+_SHORT_TOKEN = 255
+
+
+def _numpy_counts_block(data, mode, lower, dedup_per_line):
+    """Pure-numpy fallback for the fused native pass.  Exact by construction:
+    grouping is ``np.unique`` over length-prefixed token byte rows (not over
+    hashes), so colliding hashes can never merge distinct tokens."""
+    from ..blocks import Block
+
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if lower:
+        buf = _LOWER[buf]
+    starts, lens = _token_bounds(buf, mode)
+    n = len(starts)
+    if n == 0:
+        return Block.empty()
+
+    line_id = None
+    if dedup_per_line:
+        nl = np.flatnonzero(buf == 10)
+        line_starts = np.concatenate(([0], nl + 1)).astype(np.int64)
+        line_id = (np.searchsorted(line_starts, starts, side="right") - 1)
+
+    bb = buf.tobytes()
+    keys, counts = [], []
+
+    short = lens <= _SHORT_TOKEN
+    long_idx = np.flatnonzero(~short)
+    if len(long_idx):
+        agg = {}
+        seen = set()
+        for i in long_idx:
+            tok = bb[starts[i]:starts[i] + lens[i]].decode("utf-8", "replace")
+            if dedup_per_line:
+                key = (int(line_id[i]), tok)
+                if key in seen:
+                    continue
+                seen.add(key)
+            agg[tok] = agg.get(tok, 0) + 1
+        keys.extend(agg.keys())
+        counts.extend(agg.values())
+
+    sidx = np.flatnonzero(short)
+    if len(sidx):
+        s_starts = starts[sidx]
+        s_lens = lens[sidx]
+        L = int(s_lens.max())
+        idx = s_starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
+        np.clip(idx, 0, len(buf) - 1, out=idx)
+        mat = np.where(np.arange(L, dtype=np.int32)[None, :]
+                       < s_lens[:, None], buf[idx], 0)
+        rows = np.empty((len(sidx), L + 1), dtype=np.uint8)
+        rows[:, 0] = s_lens  # length prefix keeps zero-padding unambiguous
+        rows[:, 1:] = mat
+        uniq, inverse = np.unique(rows, axis=0, return_inverse=True)
+        inverse = inverse.reshape(-1)
+        if dedup_per_line:
+            combined = line_id[sidx].astype(np.int64) * len(uniq) + inverse
+            uc = np.unique(combined)
+            ucounts = np.bincount(uc % len(uniq), minlength=len(uniq))
+        else:
+            ucounts = np.bincount(inverse, minlength=len(uniq))
+        for i in range(len(uniq)):
+            ln = int(uniq[i, 0])
+            keys.append(uniq[i, 1:1 + ln].tobytes().decode("utf-8", "replace"))
+            counts.append(int(ucounts[i]))
+
+    ng = len(keys)
+    kcol = np.empty(ng, dtype=object)
+    vcol = np.empty(ng, dtype=object)
+    for i in range(ng):
+        kcol[i] = keys[i]
+        vcol[i] = (keys[i], counts[i])
+    h1, h2 = hashing.hash_keys(kcol)
+    return Block(kcol, vcol, h1, h2)
+
+
+# ASCII-only case fold for representative decoding — must match the byte
+# semantics the native hash pass uses (A-Z only; multibyte chars untouched).
+_ASCII_LOWER = bytes.maketrans(bytes(range(65, 91)), bytes(range(97, 123)))
+
+
+def _native_counts_block(data, mode, lower, dedup_per_line):
+    """Fused native tokenize(+case-fold)+count -> Block, or None.  Case
+    folding happens inside the native hash pass; representative strings
+    ASCII-fold the original bytes (vocabulary-sized work instead of a
+    buffer-sized table pass), keeping keys byte-identical to the numpy
+    fallback's case-folded buffer."""
+    from .. import native
+    from ..blocks import Block
+
+    buf = np.frombuffer(data, dtype=np.uint8)
+    res = native.token_counts(buf, 1 if mode == "word" else 0,
+                              1 if lower else 0, dedup_per_line)
+    if res is None:
+        return None
+    h1, h2, counts, rep_start, rep_len = res
+    n = len(h1)
+    keys = np.empty(n, dtype=object)
+    vals = np.empty(n, dtype=object)
+    lossy = []
+    for i in range(n):
+        s = rep_start[i]
+        raw = data[s:s + rep_len[i]]
+        if lower:
+            raw = raw.translate(_ASCII_LOWER)
+        tok = raw.decode("utf-8", "replace")
+        keys[i] = tok
+        vals[i] = (tok, int(counts[i]))
+        if "�" in tok:
+            lossy.append(i)
+    if lossy:
+        # The native pass hashed the *raw* bytes, but a lossy decode means the
+        # materialized key is the U+FFFD-substituted string — recompute those
+        # lanes from the key so the engine invariant (cached lanes ==
+        # hash_keys(key), relied on by partition routing and sorted-run
+        # merging) holds for every record.  A token that legitimately contains
+        # U+FFFD re-encodes to the same bytes, so recomputing is a no-op.
+        idx = np.asarray(lossy, dtype=np.int64)
+        rh1, rh2 = hashing.hash_keys(keys.take(idx))
+        h1 = np.array(h1, dtype=np.uint32, copy=True)
+        h2 = np.array(h2, dtype=np.uint32, copy=True)
+        h1[idx] = rh1
+        h2[idx] = rh2
+    return Block(keys, vals, h1, h2)
+
+
+def chunk_token_counts(data, mode="whitespace", lower=False):
+    """bytes -> Block of (token, count) with cached hash lanes."""
+    blk = _native_counts_block(data, mode, lower, dedup_per_line=0)
+    if blk is not None:
+        return blk
+    return _numpy_counts_block(data, mode, lower, dedup_per_line=0)
+
+
+def chunk_doc_freq(data, mode="word", lower=True):
+    """bytes -> Block of (token, n_lines_containing) — per-line dedup then
+    count, i.e. ``flat_map(lambda line: set(tokenize(line))).count()``."""
+    blk = _native_counts_block(data, mode, lower, dedup_per_line=1)
+    if blk is None:
+        blk = _numpy_counts_block(data, mode, lower, dedup_per_line=1)
+    if any(isinstance(k, str) and "�" in k for k in blk.keys):
+        # Lossy decode breaks the per-line *set* contract: distinct invalid
+        # byte tokens on one line all materialize as the same U+FFFD string,
+        # but byte-level dedup counted them separately.  Re-run on the
+        # round-trip-clean re-encoding, where byte dedup == string dedup.
+        # (A legitimate U+FFFD round-trips, so this re-run is idempotent.)
+        clean = data.decode("utf-8", "replace").encode("utf-8")
+        if clean != data:
+            blk = _native_counts_block(clean, mode, lower, dedup_per_line=1)
+            if blk is None:
+                blk = _numpy_counts_block(clean, mode, lower, dedup_per_line=1)
+    return blk
+
+
+class CountRecords(Mapper):
+    """Record-count map stage with a vectorized path for text chunks: the
+    chunk's record count is its owned newline count (+1 for an unterminated
+    final line), no per-line Python.  Emits the same ``(1, count)`` record
+    the DSL's generic ``len()`` map emits (reference dampr.py:254-259)."""
+
+    def map_blocks(self, dataset):
+        from ..blocks import Block
+
+        if hasattr(dataset, "iter_byte_blocks"):
+            # Bounded-memory scan (a .gz can expand far past RAM).
+            n = 0
+            last = b"\n"
+            for b in dataset.iter_byte_blocks():
+                n += b.count(b"\n")
+                last = b[-1:]
+            if last != b"\n" and last != b"":
+                n += 1
+            yield Block.from_pairs([(1, n)])
+            return
+        data = dataset.read_bytes()
+        n = data.count(b"\n")
+        if data and not data.endswith(b"\n"):
+            n += 1
+        yield Block.from_pairs([(1, n)])
+
+    def map(self, *datasets):
+        assert len(datasets) == 1
+        count = 0
+        for _ in datasets[0].read():
+            count += 1
+        yield 1, count
+
+
+class ParseNumbers(Mapper):
+    """Vectorized numeric-line parser: each line holds one number; records
+    come out keyed by the parsed value (so a bare ``checkpoint()`` after this
+    mapper yields a globally sorted read — the vectorized external-sort
+    path).  ``dtype`` is int64 or float64."""
+
+    def __init__(self, dtype=np.int64):
+        self.dtype = np.dtype(dtype)
+
+    def map_blocks(self, dataset):
+        from ..blocks import Block
+
+        data = dataset.read_bytes()
+        if not data:
+            return
+        toks = data.split()
+        if not toks:
+            return
+        # np.array parses each token in C and raises on the first unparsable
+        # one — the same hard error the per-record path gives.
+        arr = np.array(toks, dtype=self.dtype)
+        yield Block(arr, arr.copy())
+
+    def map(self, *datasets):
+        assert len(datasets) == 1
+        caster = int if self.dtype.kind == "i" else float
+        for _k, line in datasets[0].read():
+            if line.strip():
+                v = caster(line)
+                yield v, v
+
+
+class TokenCounts(Mapper):
+    """Vectorized word count over raw text chunks: each record downstream is
+    a ``(token, count)`` tuple, pre-folded per chunk.  Chain ``.fold_by(lambda
+    kv: kv[0], operator.add, lambda kv: kv[1])`` for the global count — its
+    Python cost is vocabulary-sized, not corpus-sized."""
+
+    def __init__(self, mode="whitespace", lower=False):
+        self.mode = mode
+        self.lower = lower
+
+    def map_blocks(self, dataset):
+        data = dataset.read_bytes()
+        yield chunk_token_counts(data, self.mode, self.lower)
+
+    def map(self, *datasets):
+        # exact per-record fallback for datasets without raw bytes
+        assert len(datasets) == 1
+        import collections
+        import re
+
+        counts = collections.Counter()
+        rx = re.compile(r"[^\w]+") if self.mode == "word" else None
+        for _k, line in datasets[0].read():
+            if self.lower:
+                line = line.lower()
+            toks = rx.split(line) if rx else line.split()
+            counts.update(t for t in toks if t)
+        return iter((t, (t, c)) for t, c in counts.items())
+
+
+class DocFreq(Mapper):
+    """Vectorized per-line token document frequency (the reference TF-IDF
+    benchmark's hot map: tf-idf-dampr.py:13-15)."""
+
+    def __init__(self, mode="word", lower=True):
+        self.mode = mode
+        self.lower = lower
+
+    def map_blocks(self, dataset):
+        data = dataset.read_bytes()
+        yield chunk_doc_freq(data, self.mode, self.lower)
+
+    def map(self, *datasets):
+        assert len(datasets) == 1
+        import collections
+        import re
+
+        counts = collections.Counter()
+        rx = re.compile(r"[^\w]+") if self.mode == "word" else None
+        for _k, line in datasets[0].read():
+            if self.lower:
+                line = line.lower()
+            toks = rx.split(line) if rx else line.split()
+            counts.update(set(t for t in toks if t))
+        return iter((t, (t, c)) for t, c in counts.items())

@@ -1,0 +1,141 @@
+"""General mesh exchange: the universal shuffle over ``lax.all_to_all``.
+
+The reference routes *every* keyed exchange — non-associative group_by,
+both join families, sort_by redistribution — through one shuffler writing
+partition files to a shared filesystem (reference dampr/base.py:416-433,
+runner.py:322-335).  :mod:`.shuffle` covers the associative-numeric case with
+a fused fold+exchange; this module covers everything else: records whose
+values are arbitrary Python objects cross the mesh as *byte payloads* inside
+a fixed-shape ``all_to_all``.
+
+Design:
+
+- **Routing** is by partition id: partition ``pid`` lives on device
+  ``pid % D``, so a partition's records (from both sides of a join) always
+  land on the same device — co-partitioning is preserved by construction,
+  exactly like the reference's shared ``Splitter``.
+- **Payload** is host-marshalled: each (source shard, destination) pair's
+  blocks serialize once per window (columnar pickle — numpy lanes serialize
+  as raw buffers), not per record.  The collective moves the real bytes;
+  the host only packs/unpacks at the boundary, which is where any system
+  marshals opaque Python payloads.
+- **Shape** is static per compile bucket: a ``[D*D, C]`` uint8 buffer
+  (row ``s*D + d`` = source s's bytes for destination d) plus an int32
+  length row, both sharded over the mesh axis.  ``C`` is the pow2 bucket of
+  the largest blob in the window, so XLA compiles one program per (mesh, C).
+- **Windows**: callers stream bounded windows through the exchange (the
+  engine bounds them by the run-store budget), so working memory never
+  depends on the total shuffled volume.
+
+There is no overflow/retry here (unlike the capacity-factor scheme in
+:func:`.shuffle.mesh_keyed_fold`): the host packs exact sizes, so the buffer
+always fits by construction.
+"""
+
+import functools
+import pickle
+
+import numpy as np
+
+from .. import settings
+from .mesh import mesh_size
+from .shuffle import _pad_pow2
+
+
+@functools.lru_cache(maxsize=None)
+def _build_exchange(mesh, axis, capacity):
+    """One all_to_all program per (mesh, capacity) bucket: moves the byte
+    buffer and the valid-length row across the mesh axis."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def per_device(bb, ln):
+        # bb: [D, C] uint8 — row j is this device's payload for device j.
+        # After all_to_all, row i is what device i sent us.
+        rb = lax.all_to_all(bb, axis, split_axis=0, concat_axis=0)
+        rl = lax.all_to_all(ln, axis, split_axis=0, concat_axis=0)
+        return rb, rl
+
+    def program(bb, ln):
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis), P(axis)))(bb, ln)
+
+    return jax.jit(program)
+
+
+def mesh_blob_exchange(mesh, blobs):
+    """Move arbitrary byte blobs across the mesh.
+
+    ``blobs``: {(src_device, dst_device): bytes}.  Returns the delivered
+    {(src_device, dst_device): bytes} — every blob crossed the collective
+    (row ``s*D+d`` of the send buffer lives on device s; the matching row of
+    the receive buffer lives on device d).
+    """
+    D = mesh_size(mesh)
+    max_len = max((len(b) for b in blobs.values()), default=0)
+    capacity = _pad_pow2(max(1, max_len), floor=64)
+    buf = np.zeros((D * D, capacity), dtype=np.uint8)
+    lens = np.zeros(D * D, dtype=np.int32)
+    for (s, d), blob in blobs.items():
+        row = s * D + d
+        lens[row] = len(blob)
+        if blob:
+            buf[row, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+    prog = _build_exchange(mesh, settings.mesh_axis, capacity)
+    rb, rl = prog(buf, lens)
+    rb = np.asarray(rb)
+    rl = np.asarray(rl)
+    out = {}
+    for d in range(D):
+        for s in range(D):
+            row = d * D + s  # device d's local row s = what s sent to d
+            n = int(rl[row])
+            if n:
+                out[(s, d)] = rb[row, :n].tobytes()
+    return out
+
+
+def _pack_group(items):
+    """[(seq, pid, Block)] -> blob.  Columnar: numpy lanes pickle as raw
+    buffers, one serialization per group, never per record."""
+    payload = [(seq, pid, (b.keys, b.values, b.h1, b.h2))
+               for seq, pid, b in items]
+    return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _unpack_group(blob):
+    from ..blocks import Block
+
+    return [(seq, pid, Block(k, v, h1, h2))
+            for seq, pid, (k, v, h1, h2) in pickle.loads(blob)]
+
+
+def mesh_shuffle_blocks(mesh, routed):
+    """Exchange one window of routed blocks across the mesh.
+
+    ``routed``: iterable of (seq, src_shard, pid, Block) — seq is a caller
+    sequence number used to restore deterministic per-partition block order
+    on the receive side (the engine's group-value order is arrival order,
+    reference semantics).  Destination device is ``pid % D``.
+
+    Returns ``(received, bytes_moved)``: received is a list of (pid, Block)
+    sorted by seq; bytes_moved counts payload bytes that crossed the
+    collective.
+    """
+    D = mesh_size(mesh)
+    groups = {}
+    for seq, src, pid, blk in routed:
+        groups.setdefault((src % D, pid % D), []).append((seq, pid, blk))
+    blobs = {sd: _pack_group(items) for sd, items in groups.items()}
+    moved = sum(len(b) for b in blobs.values())
+    recv = mesh_blob_exchange(mesh, blobs)
+    out = []
+    for (s, d), blob in recv.items():
+        for seq, pid, blk in _unpack_group(blob):
+            assert pid % D == d, (pid, d)
+            out.append((seq, pid, blk))
+    out.sort(key=lambda t: t[0])
+    return [(pid, blk) for _seq, pid, blk in out], moved

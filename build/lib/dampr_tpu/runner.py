@@ -1,0 +1,977 @@
+"""Stage executor: walks the logical graph and runs each stage.
+
+Replaces the reference's fork-join machinery (dampr/runner.py:137-374 +
+stagerunner.py) with a thread-pool executor over columnar block jobs:
+
+- **Map stages** stream records through the fused mapper chain into blocks;
+  associative stages fold map-side (the ``PartialReduceCombiner`` +
+  ``ReducedWriter`` path, reference stagerunner.py:79-129) via vectorized
+  segment kernels; every map output is hash-partitioned into the run's
+  ``n_partitions`` (the reference's ``DefaultShuffler``, base.py:416-433).
+- **Reduce stages** build a key-sorted :class:`~dampr_tpu.base.GroupedView`
+  per (partition, input) — vectorized hash-sort replacing sorted-spill +
+  heapq merge — and stream the reducer's output back into blocks.
+- **Sink stages** write durable part-files exempt from cleanup.
+
+Threads (not forked processes) carry the jobs: the heavy keyed work happens in
+numpy/XLA kernels that release the GIL, and a single process keeps one device
+context (forking around a live TPU runtime is not safe).  Stage barriers are
+preserved: stage N completes before N+1 starts, exactly like the reference's
+per-stage join (runner.py:174-232).
+
+Failure semantics: a job exception fails the run immediately with the original
+traceback (the reference deadlocks on a dead worker — stagerunner.py:35-38 —
+which SURVEY.md flags as a defect not to replicate).
+"""
+
+import copy
+import logging
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from . import base, settings, storage
+from .blocks import Block, BlockBuilder
+from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
+from .graph import GInput, GMap, GReduce, GSink
+from .ops import segment
+
+log = logging.getLogger("dampr_tpu.runner")
+
+# Cap on accumulated map-side partial folds before re-compaction; bounds the
+# map-side working set the way the reference's reduce_buffer flush does
+# (dampr.py:661-673) but in block units.
+_PARTIAL_FANIN = 8
+
+
+def _clone_op(op):
+    """Per-job operator instance.  Stateful operators (BlockMapper/BlockReducer
+    subclasses) carry per-chunk state; the reference isolates them by process
+    fork, we by deep copy (functions/closures are copied by reference, which
+    is safe — they are not mutated)."""
+    return copy.deepcopy(op)
+
+
+class _SinkOutput(object):
+    """Durable sink result: a list of part-file datasets."""
+
+    def __init__(self, paths):
+        self.paths = paths
+
+    def datasets(self):
+        return [SinkDataset(p) for p in self.paths]
+
+
+class OutputDataset(Dataset):
+    """Final-output view over a PartitionSet: reads records in ascending key
+    order (the reference heap-merges sorted partition runs —
+    runner.py:352-374).  Each partition is sorted independently and the
+    partitions stream through a lazy k-way heap merge, so ``read(k)`` never
+    materializes one giant concatenated copy and peak memory is the sum of
+    partition working sets, not 2x the output."""
+
+    def __init__(self, pset, store=None):
+        self.pset = pset
+        self.store = store
+
+    def _partition_stream(self, pid):
+        from .dataset import OrderKey
+
+        try:
+            blk = self._sorted_partition_block(pid)
+        except TypeError:
+            # Uncomparable mixed keys: stable Python sort under the
+            # total-order wrapper (rare path, matches the merge order).
+            blk = Block.concat([r.get() for r in self.pset.refs(pid)])
+            keys = blk.keys
+            order = np.asarray(
+                sorted(range(len(blk)), key=lambda i: OrderKey(keys[i])),
+                dtype=np.int64)
+            blk = blk.take(order)
+        if blk is None:
+            return iter(())
+        return blk.iter_pairs()
+
+    def _sorted_concat(self):
+        """Vectorized fast path: one concat + stable argsort of the whole
+        output.  Returns None when it shouldn't run — the working copies
+        (refs + concat + take) peak near 3x the output size, so it is gated
+        at a third of the memory budget; uncomparable mixed keys also bail
+        to the streamed merge."""
+        total = sum(r.nbytes for r in self.pset.all_refs())
+        budget = (self.store.budget if self.store is not None
+                  else settings.max_memory_per_stage)
+        if total * 3 > budget:
+            return None
+        blk = Block.concat([r.get() for r in self.pset.all_refs()])
+        if not len(blk):
+            return blk
+        try:
+            order = np.argsort(blk.keys, kind="stable")
+        except TypeError:
+            return None
+        return blk.take(order)
+
+    def read(self):
+        import itertools
+
+        pids = sorted(self.pset.parts)
+        if not pids:
+            return iter(())
+        if len(pids) == 1:
+            return self._partition_stream(pids[0])
+        blk = self._sorted_concat()
+        if blk is not None:
+            return blk.iter_pairs()
+        blocks = self._vector_merge_blocks(pids)
+        if blocks is not None:
+            return itertools.chain.from_iterable(
+                b.iter_pairs() for b in blocks)
+        return self._merge_partitions(pids)
+
+    def _merge_partitions(self, pids):
+        from .dataset import StreamDataset, merged_read
+
+        streams = [StreamDataset(self._partition_stream(pid)) for pid in pids]
+        return merged_read(streams)
+
+    def _sorted_partition_block(self, pid):
+        blk = Block.concat([r.get() for r in self.pset.refs(pid)])
+        if not len(blk):
+            return None
+        order = np.argsort(blk.keys, kind="stable")  # TypeError -> caller
+        return blk.take(order)
+
+    def _vector_merge_blocks(self, pids, chunk=1 << 16):
+        """K-way merge of key-sorted numeric-keyed partitions, emitted as
+        blocks in bounded vectorized chunks: each round advances to the
+        smallest partition-chunk boundary key, gathers every record at or
+        below it via searchsorted, and stable-sorts only that slice —
+        replacing per-record Python heap merging.  Returns None (fall back to
+        the record merge) when any partition's keys are non-numeric."""
+        parts = []
+        for pid in pids:
+            refs = self.pset.refs(pid)
+            if any(getattr(r, "key_dtype", np.dtype(object)) == object
+                   for r in refs):
+                return None
+            blk = self._sorted_partition_block(pid)
+            if blk is not None:
+                parts.append(blk)
+        if not parts:
+            return iter(())
+
+        def slice_of(blk, a, b):
+            return Block(
+                blk.keys[a:b], blk.values[a:b],
+                None if blk.h1 is None else blk.h1[a:b],
+                None if blk.h2 is None else blk.h2[a:b])
+
+        def gen():
+            pos = [0] * len(parts)
+            n_parts = len(parts)
+            while True:
+                bound = None
+                active = False
+                for i in range(n_parts):
+                    blk = parts[i]
+                    if pos[i] >= len(blk):
+                        continue
+                    active = True
+                    edge = min(pos[i] + chunk, len(blk)) - 1
+                    k = blk.keys[edge]
+                    if bound is None or k < bound:
+                        bound = k
+                if not active:
+                    return
+                # Records strictly below the bound: at most `chunk` per
+                # partition by construction, so this gather is bounded —
+                # stable sort keeps partition-order ties like the heap merge.
+                pieces = []
+                for i in range(n_parts):
+                    blk = parts[i]
+                    if pos[i] >= len(blk):
+                        continue
+                    end = int(np.searchsorted(blk.keys, bound, side="left"))
+                    if end > pos[i]:
+                        pieces.append(slice_of(blk, pos[i], end))
+                        pos[i] = end
+                if pieces:
+                    merged = Block.concat(pieces)
+                    yield merged.take(
+                        np.argsort(merged.keys, kind="stable"))
+                # Records equal to the bound need no sorting: emit them as
+                # raw partition-order slices in bounded pieces, so a hot key
+                # with millions of duplicates streams instead of
+                # materializing (the heap merge's tie order is partition
+                # order, preserved here).
+                for i in range(n_parts):
+                    blk = parts[i]
+                    if pos[i] >= len(blk):
+                        continue
+                    end = int(np.searchsorted(blk.keys, bound, side="right"))
+                    at = pos[i]
+                    while at < end:
+                        sub = min(at + chunk, end)
+                        yield slice_of(blk, at, sub)
+                        at = sub
+                    pos[i] = end
+
+        return gen()
+
+    def sorted_blocks(self):
+        """Bulk access: the key-sorted output as columnar blocks.  Under a
+        third of the memory budget: one concatenated sorted block.  Numeric
+        keys over budget: the vectorized k-way merge (block sizes bounded by
+        ~chunk x partitions, not settings.batch_size).  Otherwise: the
+        per-record merge re-blocked at batch_size."""
+        blk = self._sorted_concat()
+        if blk is not None:
+            if len(blk):
+                yield blk
+            return
+        pids = sorted(self.pset.parts)
+        blocks = self._vector_merge_blocks(pids)
+        if blocks is not None:
+            for b in blocks:
+                yield b
+            return
+        builder = BlockBuilder(settings.batch_size)
+        for k, v in self._merge_partitions(pids):
+            out = builder.add(k, v)
+            if out is not None:
+                yield out
+        out = builder.flush()
+        if out is not None:
+            yield out
+
+    def delete(self):
+        self.pset.delete(self.store)
+
+
+class StageStats(object):
+    """Per-stage observability (the reference has log lines only — SURVEY §5
+    commits to structured metrics)."""
+
+    __slots__ = ("stage_id", "kind", "n_jobs", "records_out", "seconds")
+
+    def __init__(self, stage_id, kind):
+        self.stage_id = stage_id
+        self.kind = kind
+        self.n_jobs = 0
+        self.records_out = 0
+        self.seconds = 0.0
+
+    def as_dict(self):
+        return {"stage": self.stage_id, "kind": self.kind,
+                "jobs": self.n_jobs, "records_out": self.records_out,
+                "seconds": round(self.seconds, 4)}
+
+
+class MTRunner(object):
+    """The scheduler: sequential stage walk, parallel jobs within a stage
+    (reference MTRunner, runner.py:235-374)."""
+
+    def __init__(self, name, graph, n_maps=None, n_reducers=None,
+                 n_partitions=None, memory_budget=None):
+        self.name = name
+        self.graph = graph
+        self.n_maps = n_maps or settings.max_processes
+        self.n_reducers = n_reducers or settings.max_processes
+        self.n_partitions = n_partitions or settings.partitions
+        self.store = storage.RunStore(name, budget=memory_budget)
+        self.stats = []
+        self.mesh_folds = 0  # reduces executed via the mesh collective path
+        self.mesh_exchanges = 0  # general shuffles routed over all_to_all
+        self.mesh_exchange_bytes = 0  # payload bytes that crossed the mesh
+        self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
+
+    # -- job fan-out --------------------------------------------------------
+    def _pool_run(self, fn, jobs, n_workers):
+        retries = settings.job_retries
+        if retries:
+            inner = fn
+
+            def fn(job):  # noqa: F811 - deliberate retry wrapper
+                for attempt in range(retries + 1):
+                    try:
+                        # attempt() rolls back this attempt's block
+                        # registrations on failure so retries never orphan
+                        # refs against the memory budget.
+                        with self.store.attempt():
+                            return inner(job)
+                    except Exception:
+                        if attempt == retries:
+                            raise
+                        log.warning(
+                            "job failed (attempt %d/%d), retrying",
+                            attempt + 1, retries + 1, exc_info=True)
+
+        n_workers = max(1, min(n_workers, len(jobs), settings.max_processes))
+        if n_workers == 1 or len(jobs) <= 1:
+            return [fn(j) for j in jobs]
+        with ThreadPoolExecutor(max_workers=n_workers) as pool:
+            return list(pool.map(fn, jobs))
+
+    # -- stage input views --------------------------------------------------
+    def _as_chunks(self, entry):
+        """Entry (tap Chunker or PartitionSet) -> list of map-job datasets
+        (the DMChunker flattening, reference dataset.py:622-629)."""
+        if isinstance(entry, storage.PartitionSet):
+            ds = [BlockDataset([ref]) for ref in entry.all_refs()]
+            return ds if ds else [BlockDataset([])]
+        if isinstance(entry, _SinkOutput):
+            return entry.datasets()
+        assert isinstance(entry, Chunker), entry
+        chunks = list(entry.chunks())
+        return chunks if chunks else [BlockDataset([])]
+
+    # -- map ---------------------------------------------------------------
+    def run_map(self, stage_id, stage, env):
+        entries = [env[s] for s in stage.inputs]
+        chunks = self._as_chunks(entries[0])
+        supplementary = [self._as_chunks(e) for e in entries[1:]]
+
+        combine_op = None
+        if isinstance(stage.combiner, base.PartialReduceCombiner):
+            combine_op = stage.combiner.op
+        elif "binop" in stage.options:
+            combine_op = segment.as_assoc_op(stage.options["binop"])
+
+        pin = bool(stage.options.get("memory"))
+        P = self.n_partitions
+        # Hash-sorted runs are only needed when a reduce consumes this output
+        # (it's what the over-budget streaming merge relies on); stages
+        # feeding sinks or final reads skip the sort — their consumers
+        # re-order by key anyway.
+        feeds_reduce = any(
+            isinstance(s, GReduce) and stage.output in s.inputs
+            for s in self.graph.stages)
+
+        def job(chunk):
+            mapper = _clone_op(stage.mapper)
+            builder = BlockBuilder(settings.batch_size)
+            raw, partials = [], []
+            # Vectorized block protocol: mappers exposing map_blocks consume
+            # the chunk's raw bytes and emit whole Blocks, skipping the
+            # per-record Python path entirely (the SURVEY §7 dual-path).
+            use_blocks = (not supplementary
+                          and hasattr(mapper, "map_blocks")
+                          and hasattr(chunk, "read_bytes"))
+            if use_blocks:
+                kvs = None
+            elif supplementary:
+                kvs = mapper.map(chunk, *supplementary)
+            else:
+                kvs = mapper.map(chunk)
+
+            def take(blk):
+                if blk is None or not len(blk):
+                    return
+                if combine_op is not None:
+                    partials.append(segment.fold_block(blk, combine_op))
+                    if len(partials) >= _PARTIAL_FANIN:
+                        merged = segment.fold_block(
+                            Block.concat(partials), combine_op)
+                        del partials[:]
+                        partials.append(merged)
+                else:
+                    raw.append(blk)
+
+            if use_blocks:
+                for blk in mapper.map_blocks(chunk):
+                    take(blk)
+            else:
+                for k, v in kvs:
+                    take(builder.add(k, v))
+                take(builder.flush())
+
+            if combine_op is not None and partials:
+                raw = [segment.fold_block(Block.concat(partials), combine_op)]
+
+            # Register with the store *inside* the job so the memory budget is
+            # enforced while the stage runs, not after all jobs complete.
+            # Every registered block is a hash-sorted run (fold outputs
+            # already are; raw blocks sort here — stable, so equal keys keep
+            # input order), which is what lets over-budget reduces stream a
+            # k-way merge instead of materializing the partition.
+            out = {}
+            for blk in raw:
+                if combine_op is None and feeds_reduce:
+                    blk = blk.sort_by_hash()
+                for pid, sub in blk.split_by_partition(P).items():
+                    out.setdefault(pid, []).append(
+                        self.store.register(sub, pin=pin))
+            return out
+
+        n_maps = stage.options.get("n_maps", self.n_maps)
+        results = self._pool_run(job, chunks, n_maps)
+
+        pset = storage.PartitionSet(P)
+        for mapping in results:
+            for pid, refs in mapping.items():
+                for ref in refs:
+                    pset.add(pid, ref)
+        self._compact_partitions(pset, combine_op, pin, feeds_reduce)
+        return pset, pset.total_records(), len(chunks)
+
+    def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True):
+        """Block-count governor (the reference's file-count combiner rounds,
+        runner.py:293-320): partitions holding more than max_files_per_stage
+        refs merge — re-folding under the stage's associative op when present
+        — so ref counts and reduce-side fan-in stay bounded.
+
+        Memory discipline: refs merge in rounds of at most ``limit`` at a
+        time, and each round's source refs are dropped from the store before
+        the merged block registers, so peak residency stays one round's worth
+        over budget instead of the whole partition (and near-budget source
+        refs never get pointlessly spilled just to be deleted)."""
+        limit = max(2, settings.max_files_per_stage)
+        for pid, refs in list(pset.parts.items()):
+            while len(refs) > limit:
+                merged_refs = []
+                for at in range(0, len(refs), limit):
+                    round_refs = refs[at:at + limit]
+                    if len(round_refs) == 1:
+                        merged_refs.append(round_refs[0])
+                        continue
+                    blocks = [r.get() for r in round_refs]
+                    for r in round_refs:
+                        self.store.drop_ref(r)
+                    merged = Block.concat(blocks)
+                    del blocks
+                    if combine_op is not None:
+                        merged = segment.fold_block(merged, combine_op)
+                    elif feeds_reduce:
+                        # keep the run invariant: merged blocks stay
+                        # hash-sorted so streaming reduces can merge them
+                        merged = merged.sort_by_hash()
+                    merged_refs.append(self.store.register(merged, pin=pin))
+                refs = merged_refs
+            pset.parts[pid] = refs
+
+    # -- reduce ------------------------------------------------------------
+    def _mesh_reduce(self, stage, entries):
+        """Distributed fast path for device-foldable associative reduces:
+        window-streamed mesh collective folds (local fold -> all_to_all by
+        hash -> final fold per window, partials re-folded through the same
+        program), so host memory is one window plus the distinct-key
+        accumulator — never the partition set, which may be arbitrarily
+        over-budget and spilled.  Returns None whenever the host path is
+        required for exactness: object values, lane overflow (every
+        mesh_keyed_fold call re-checks its inputs, and partial magnitudes
+        are bounded by element magnitudes, so per-call checks compose),
+        a 64-bit key collision, or accumulator cardinality past the budget."""
+        mode = str(settings.mesh_fold).lower()
+        if mode in ("off", "0", "false") or not settings.use_device:
+            return None
+        if len(entries) != 1 or not isinstance(stage.reducer,
+                                               base.AssocFoldReducer):
+            return None
+        op = stage.reducer.op
+        if op.kind not in ("sum", "min", "max"):
+            return None
+        import jax
+
+        if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+            return None
+
+        refs = list(entries[0].all_refs())
+        if not refs:
+            return storage.PartitionSet(self.n_partitions), 0, 1
+        # Cheap metadata check before touching any (possibly spilled) data.
+        if any(getattr(r, "value_dtype", object) == object for r in refs):
+            return None
+
+        from .blocks import _concat_cols
+        from .ops.hashing import combine64
+        from .parallel import mesh_keyed_fold
+        from .parallel.mesh import data_mesh
+
+        mesh = data_mesh()
+        x64 = jax.config.jax_enable_x64
+        window_budget = max(1 << 20, self.store.budget // 4)
+        acc_budget = max(1 << 20, self.store.budget // 4)
+
+        class _HostPath(Exception):
+            pass
+
+        # Distinct-key table: u64-sorted hash lanes with the matching keys.
+        # Grows with key cardinality only; replaces the former all-records
+        # host concat + sort + Python dict.
+        kt = {"u": np.empty(0, dtype=np.uint64),
+              "k": None}  # dtype set by the first window (stays numeric
+        #                   for numeric keys — the output block inherits it)
+        partials = []  # folded (h1, h2, v) lane triples
+
+        def keys_equal(a, b):
+            if a.dtype != object and b.dtype != object:
+                return bool(np.all(a == b))
+            return all(x == y for x, y in zip(a, b))
+
+        def merge_table(blk, h1, h2):
+            """Fold the window's (hash -> key) pairs into the sorted table —
+            sort only the window, then a linear searchsorted+insert merge —
+            verifying equal 64-bit hashes always carry equal keys."""
+            u = combine64(h1, h2)
+            worder = np.argsort(u, kind="stable")
+            su = u[worder]
+            sk = np.asarray(blk.keys).take(worder)
+            # In-window dedup with the collision check on adjacent dups.
+            first = np.empty(len(su), dtype=bool)
+            first[0] = True
+            np.not_equal(su[1:], su[:-1], out=first[1:])
+            dup = np.flatnonzero(~first)
+            if len(dup) and not keys_equal(sk.take(dup), sk.take(dup - 1)):
+                raise _HostPath  # 64-bit collision
+            keep = np.flatnonzero(first)
+            su = su[keep]
+            sk = sk.take(keep)
+            if kt["k"] is None:
+                kt["u"], kt["k"] = su, sk
+            else:
+                if kt["k"].dtype != sk.dtype:
+                    nk = len(kt["k"])
+                    both = _concat_cols([kt["k"], sk])
+                    kt["k"] = both[:nk]
+                    sk = both[nk:]
+                pos = np.searchsorted(kt["u"], su)
+                pos_c = np.minimum(pos, max(len(kt["u"]) - 1, 0))
+                exists = (kt["u"][pos_c] == su) if len(kt["u"]) else (
+                    np.zeros(len(su), dtype=bool))
+                hit = np.flatnonzero(exists)
+                if len(hit) and not keys_equal(
+                        sk.take(hit), kt["k"].take(pos_c[hit])):
+                    raise _HostPath  # cross-window 64-bit collision
+                new = np.flatnonzero(~exists)
+                if len(new):
+                    kt["u"] = np.insert(kt["u"], pos[new], su[new])
+                    kt["k"] = np.insert(kt["k"], pos[new], sk.take(new))
+            if len(kt["u"]) * 80 > acc_budget:
+                raise _HostPath  # extreme cardinality: stream on host
+
+        def compact():
+            h1 = np.concatenate([p[0] for p in partials])
+            h2 = np.concatenate([p[1] for p in partials])
+            v = np.concatenate([p[2] for p in partials])
+            try:
+                f = mesh_keyed_fold(mesh, h1, h2, v, op.kind)
+            except ValueError:
+                raise _HostPath
+            del partials[:]
+            partials.append(f)
+
+        def flush(win_blocks):
+            blk = Block.concat(win_blocks)
+            if not len(blk):
+                return
+            vals = blk.values
+            if vals.dtype == np.bool_:
+                vals = vals.astype(np.int64)
+            if vals.dtype == np.float64 and not x64:
+                raise _HostPath
+            h1, h2 = blk.hashes()
+            merge_table(blk, h1, h2)
+            try:
+                f = mesh_keyed_fold(mesh, h1, h2, vals, op.kind)
+            except ValueError:
+                raise _HostPath
+            partials.append(f)
+            if len(partials) >= _PARTIAL_FANIN:
+                compact()
+
+        try:
+            win, wbytes = [], 0
+            for ref in refs:
+                for w in ref.iter_windows():
+                    if not len(w):
+                        continue
+                    win.append(w)
+                    wbytes += w.nbytes()
+                    if wbytes >= window_budget:
+                        flush(win)
+                        win, wbytes = [], 0
+            if win:
+                flush(win)
+            if not partials:
+                return storage.PartitionSet(self.n_partitions), 0, 1
+            if len(partials) > 1:
+                compact()
+        except _HostPath:
+            log.info("mesh fold: falling back to the host path")
+            return None
+
+        fh1 = np.asarray(partials[0][0])
+        fh2 = np.asarray(partials[0][1])
+        fv = np.asarray(partials[0][2])
+        # Vectorized hash -> key join against the sorted table (every output
+        # hash entered the table with its window).
+        fu = combine64(fh1, fh2)
+        idx = np.minimum(np.searchsorted(kt["u"], fu), len(kt["u"]) - 1)
+        assert bool(np.all(kt["u"][idx] == fu)), "mesh fold lost a key"
+        out_keys = kt["k"].take(idx)
+
+        P = self.n_partitions
+        pin = bool(stage.options.get("memory"))
+        n = len(fu)
+        vcol = np.empty(n, dtype=object)
+        for i in range(n):
+            k = out_keys[i]
+            if isinstance(k, np.generic):
+                k = k.item()
+            v = fv[i]
+            vcol[i] = (k, v.item() if isinstance(v, np.generic) else v)
+        out_blk = Block(out_keys, vcol, fh1, fh2)
+
+        pset = storage.PartitionSet(P)
+        nrec = 0
+        for pid, sub in out_blk.split_by_partition(P).items():
+            nrec += len(sub)
+            pset.add(pid, self.store.register(sub, pin=pin))
+        self.mesh_folds += 1
+        log.info("mesh fold: %d keys folded across %d devices",
+                 nrec, len(jax.devices()))
+        return pset, nrec, 1
+
+    def _mesh_exchange_entries(self, entries):
+        """The general shuffle on the mesh (the reference's universal
+        DefaultShuffler — base.py:416-433 — as a collective): every input
+        partition's blocks cross a fixed-shape ``all_to_all`` byte exchange,
+        streamed in windows bounded by the run budget, with partition pid
+        landing on device pid % D.  Joins stay co-partitioned because both
+        inputs route identically.  Returns the exchanged PartitionSets (new
+        refs registered against the store), or None when the mesh path is
+        disabled or only one device is visible."""
+        mode = str(settings.mesh_exchange).lower()
+        if mode in ("off", "0", "false") or not settings.use_device:
+            return None
+        import jax
+
+        if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+            return None
+        from .parallel import exchange as px
+        from .parallel.mesh import data_mesh, mesh_size
+
+        mesh = data_mesh()
+        D = mesh_size(mesh)
+        # Worst-case skew sends a whole window to one (src, dst) pair, and
+        # the send buffer is D*D rows of that blob's pow2 bucket — bound the
+        # window so the buffer stays a fraction of the budget.
+        window = max(1 << 18, self.store.budget // (8 * D * D))
+
+        out_entries = []
+        ran_exchange = False
+        for pset in entries:
+            out = storage.PartitionSet(pset.n_partitions)
+            batch, batch_bytes = [], 0
+            seq = 0
+
+            def flush():
+                nonlocal batch, batch_bytes, ran_exchange
+                if not batch:
+                    return
+                routed = [
+                    (s, s % D, pid,
+                     item.get() if isinstance(item, storage.BlockRef)
+                     else item)
+                    for s, pid, item in batch]
+                received, moved = px.mesh_shuffle_blocks(mesh, routed)
+                for pid, blk in received:
+                    out.add(pid, self.store.register(blk))
+                self.mesh_exchange_bytes += moved
+                ran_exchange = True
+                batch, batch_bytes = [], 0
+
+            def add(pid, item, nbytes):
+                nonlocal batch_bytes, seq
+                batch.append((seq, pid, item))
+                seq += 1
+                batch_bytes += nbytes
+                if batch_bytes >= window:
+                    flush()
+
+            for pid in sorted(pset.parts):
+                for ref in pset.parts[pid]:
+                    if ref.nbytes <= window:
+                        add(pid, ref, ref.nbytes)
+                        continue
+                    # An over-window block would amplify to a D*D-row buffer
+                    # of its own pow2 size; stream it in bounded pieces
+                    # instead (consecutive slices of a sorted run stay
+                    # sorted runs, and seq order keeps arrival order).
+                    piece, pbytes = [], 0
+                    for w in ref.iter_windows():
+                        piece.append(w)
+                        pbytes += w.nbytes()
+                        if pbytes >= window:
+                            add(pid, Block.concat(piece), pbytes)
+                            piece, pbytes = [], 0
+                    if piece:
+                        add(pid, Block.concat(piece), pbytes)
+            flush()
+            out_entries.append(out)
+        if ran_exchange:
+            self.mesh_exchanges += 1
+        return out_entries
+
+    def run_reduce(self, stage_id, stage, env):
+        entries = [env[s] for s in stage.inputs]
+        for e in entries:
+            assert isinstance(e, storage.PartitionSet), (
+                "reduce inputs must be materialized partitions; the DSL "
+                "checkpoints before grouping")
+        fast = self._mesh_reduce(stage, entries)
+        if fast is not None:
+            return fast
+        exchanged = self._mesh_exchange_entries(entries)
+        if exchanged is not None:
+            entries = exchanged
+        P = self.n_partitions
+        pin = bool(stage.options.get("memory"))
+
+        threshold = settings.streaming_reduce_threshold
+        if threshold is None:
+            threshold = self.store.budget
+        # The streaming merge yields groups in hash order, not key order —
+        # safe for per-group reducers (Reduce/KeyedReduce/AssocFoldReducer,
+        # where each group is independent), but Stream/BlockReducers observe
+        # the group sequence directly, so they always get the key-ordered
+        # materialized view.
+        order_insensitive = isinstance(
+            stage.reducer, (base.Reduce, base.AssocFoldReducer))
+
+        joinable = isinstance(
+            stage.reducer, (base.KeyedInnerJoin, base.KeyedLeftJoin,
+                            base.KeyedOuterJoin))
+
+        def _streaming_assoc_fold(refs, reducer):
+            """Over-budget associative fold, vectorized: fold each spill
+            window as it streams and re-compact partials — the working set is
+            one accumulator of *distinct keys*, not the partition's records
+            (the reduce-side mirror of the map-side _PARTIAL_FANIN combine).
+            Returns None (caller falls back to the per-record stream) if the
+            accumulator itself outgrows the threshold (extreme cardinality).
+            """
+            op = reducer.op
+            partials = []
+
+            def compact():
+                merged = segment.fold_block(Block.concat(partials), op)
+                del partials[:]
+                partials.append(merged)
+                return merged.nbytes()
+
+            for ref in refs:
+                for window in ref.iter_windows():
+                    if not len(window):
+                        continue
+                    partials.append(segment.fold_block(window, op))
+                    if len(partials) >= _PARTIAL_FANIN:
+                        if compact() > threshold:
+                            return None
+            if not partials:
+                return iter(())
+            self.streamed_assoc_folds += 1
+            final = segment.fold_sorted(
+                segment.sort_and_group(Block.concat(partials)), op)
+            gkeys = final.keys
+            try:
+                order = np.argsort(gkeys, kind="stable")
+            except TypeError:
+                order = np.arange(len(final))
+
+            def emit():
+                vals = final.values
+                for gi in order:
+                    k = gkeys[gi]
+                    v = vals[gi]
+                    k = k.item() if isinstance(k, np.generic) else k
+                    v = v.item() if isinstance(v, np.generic) else v
+                    yield k, (k, v)
+
+            return emit()
+
+        def job(pid):
+            if joinable and len(entries) == 2:
+                sizes = [sum(r.nbytes for r in pset.refs(pid))
+                         for pset in entries]
+                if sum(sizes) > threshold:
+                    # Over-budget join partition: hash-ordered streaming
+                    # merge join — memory bound is the largest single
+                    # join-key group, not the partition.
+                    log.info(
+                        "partition %d join (%.1f MB) exceeds the streaming "
+                        "threshold: merging by hash order", pid,
+                        sum(sizes) / 1e6)
+                    lview = base.StreamingGroupedView(entries[0].refs(pid))
+                    rview = base.StreamingGroupedView(entries[1].refs(pid))
+                    reducer = _clone_op(stage.reducer)
+                    builder = BlockBuilder(settings.batch_size)
+                    refs_out = []
+                    for k, v in base.streaming_merge_join(lview, rview,
+                                                          reducer):
+                        blk = builder.add(k, v)
+                        if blk is not None:
+                            refs_out.append(
+                                self.store.register(blk, pin=pin))
+                    blk = builder.flush()
+                    if blk is not None:
+                        refs_out.append(self.store.register(blk, pin=pin))
+                    return pid, refs_out
+            record_stream = None
+            if len(entries) == 1:
+                prefs = entries[0].refs(pid)
+                part_bytes = sum(r.nbytes for r in prefs)
+                if (part_bytes > threshold
+                        and isinstance(stage.reducer, base.AssocFoldReducer)
+                        and stage.reducer.op.kind is not None):
+                    record_stream = _streaming_assoc_fold(
+                        prefs, stage.reducer)
+
+            if record_stream is None:
+                views = []
+                for pset in entries:
+                    refs = pset.refs(pid)
+                    part_bytes = sum(r.nbytes for r in refs)
+                    if (len(entries) == 1 and order_insensitive
+                            and part_bytes > threshold):
+                        # Out-of-core partition: stream a k-way merge over
+                        # the hash-sorted runs — one window per run resident
+                        # — instead of materializing the whole partition.
+                        # (Over-budget joins were handled above; assoc folds
+                        # with recognized ops took the vectorized accumulator
+                        # unless cardinality blew it; Stream/BlockReducers
+                        # still materialize.)
+                        log.info(
+                            "partition %d (%.1f MB) exceeds the streaming "
+                            "threshold: groups will stream in hash order",
+                            pid, part_bytes / 1e6)
+                        views.append(base.StreamingGroupedView(refs))
+                    else:
+                        views.append(base.GroupedView(
+                            [ref.get() for ref in refs]))
+                reducer = _clone_op(stage.reducer)
+                record_stream = reducer.reduce(*views)
+
+            builder = BlockBuilder(settings.batch_size)
+            refs = []
+            for k, v in record_stream:
+                blk = builder.add(k, v)
+                if blk is not None:
+                    refs.append(self.store.register(blk, pin=pin))
+            blk = builder.flush()
+            if blk is not None:
+                refs.append(self.store.register(blk, pin=pin))
+            return pid, refs
+
+        n_reducers = stage.options.get("n_reducers", self.n_reducers)
+        try:
+            results = self._pool_run(job, list(range(P)), n_reducers)
+        finally:
+            if exchanged is not None:
+                # The exchanged copies are intermediates private to this
+                # reduce; the originals in env still own the stage output
+                # lifecycle.  finally: a reducer exception must not leak a
+                # duplicate of the stage input against the budget.
+                for e in exchanged:
+                    e.delete(self.store)
+
+        pset = storage.PartitionSet(P)
+        nrec = 0
+        for pid, refs in results:
+            for ref in refs:
+                nrec += len(ref)
+                pset.add(pid, ref)
+        return pset, nrec, P
+
+    # -- sink --------------------------------------------------------------
+    def run_sink(self, stage_id, stage, env):
+        entries = [env[s] for s in stage.inputs]
+        chunks = self._as_chunks(entries[0])
+        os.makedirs(stage.path, exist_ok=True)
+
+        def job(args):
+            i, chunk = args
+            mapper = _clone_op(stage.sinker)
+            part = os.path.join(stage.path, "part-{}".format(i))
+            n = 0
+            with open(part, "w", encoding="utf-8") as f:
+                for _k, v in mapper.map(chunk):
+                    f.write("{}\n".format(v))
+                    n += 1
+            return part, n
+
+        n_maps = stage.options.get("n_maps", self.n_maps)
+        results = self._pool_run(job, list(enumerate(chunks)), n_maps)
+        paths = [p for p, _ in results]
+        nrec = sum(n for _, n in results)
+        return _SinkOutput(paths), nrec, len(chunks)
+
+    # -- main walk ---------------------------------------------------------
+    def run(self, outputs, cleanup=True):
+        if settings.profile_dir:
+            import jax
+
+            with jax.profiler.trace(settings.profile_dir):
+                return self._run(outputs, cleanup)
+        return self._run(outputs, cleanup)
+
+    def _run(self, outputs, cleanup=True):
+        env = {}
+        to_delete = []
+        n_stages = len(self.graph.stages)
+        for sid, stage in enumerate(self.graph.stages):
+            t0 = time.time()
+            self.store.set_stage(sid)
+            if isinstance(stage, GInput):
+                env[stage.output] = stage.tap
+                continue
+
+            log.info("Stage %s/%s: %r", sid + 1, n_stages, stage)
+            if isinstance(stage, GMap):
+                result, nrec, njobs = self.run_map(sid, stage, env)
+                kind = "map"
+                to_delete.append(stage.output)
+            elif isinstance(stage, GReduce):
+                result, nrec, njobs = self.run_reduce(sid, stage, env)
+                kind = "reduce"
+                to_delete.append(stage.output)
+            elif isinstance(stage, GSink):
+                result, nrec, njobs = self.run_sink(sid, stage, env)
+                kind = "sink"  # durable: never cleaned up
+            else:
+                raise TypeError("Unknown stage type: {!r}".format(stage))
+
+            env[stage.output] = result
+            st = StageStats(sid, kind)
+            st.n_jobs = njobs
+            st.records_out = nrec
+            st.seconds = time.time() - t0
+            self.stats.append(st)
+            log.info("Stage %s done: %s", sid + 1, st.as_dict())
+
+        ret = []
+        keep = set()
+        for source in outputs:
+            keep.add(source)
+            entry = env[source]
+            if isinstance(entry, storage.PartitionSet):
+                ret.append(OutputDataset(entry, self.store))
+            elif isinstance(entry, _SinkOutput):
+                from .dataset import CatDataset
+                ret.append(CatDataset(entry.datasets()))
+            else:  # raw tap requested directly
+                from .dataset import CatDataset
+                ret.append(CatDataset(list(entry.chunks())))
+
+        if cleanup:
+            for source in to_delete:
+                if source in keep:
+                    continue
+                entry = env.get(source)
+                if isinstance(entry, storage.PartitionSet):
+                    entry.delete(self.store)
+
+        return ret

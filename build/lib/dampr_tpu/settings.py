@@ -1,0 +1,144 @@
+"""Global configuration for dampr_tpu.
+
+Parity surface: the reference exposes mutable module globals in dampr/settings.py:1-37
+(max_processes, compress_level, partitions, max_files_per_stage, batch_size,
+memory_checker_type, max_memory_per_worker).  We keep the same "assign a module
+attribute" ergonomics so reference users can switch without relearning config, and add
+TPU-specific knobs (mesh shape, device batch size, spill tiers) that have no reference
+analog.
+
+Unlike the reference, per-op overrides still ride graph-node ``options`` dicts
+(reference: runner.py:285/331, stagerunner.py:58-95), threaded through unchanged.
+"""
+
+import os
+
+import multiprocessing
+
+# ---------------------------------------------------------------------------
+# Parity knobs (same names/meaning as reference dampr/settings.py)
+# ---------------------------------------------------------------------------
+
+#: Max host-side worker threads for input IO / opaque-UDF map stages.  The
+#: reference forks this many processes (settings.py:5); we use threads because the
+#: heavy lifting happens on-device and numpy/IO release the GIL.
+max_processes = multiprocessing.cpu_count()
+
+#: gzip compression level for spilled blocks (reference settings.py:8).
+compress_level = 1
+
+#: Number of shuffle partitions (reference settings.py:11 uses 91).  We default to a
+#: multiple of typical mesh sizes so partitions map evenly onto devices.
+partitions = 64
+
+#: Upper bound on materialized block files per stage before a merge pass runs
+#: (reference settings.py:16 `max_files_per_stage`).
+max_files_per_stage = 50
+
+#: Records per host block flushed to the device path (reference settings.py:20 uses
+#: 1000 for pickle batches; device batches want to be much larger to amortize
+#: dispatch).
+batch_size = 65536
+
+#: Byte budget per stage for in-memory blocks before spilling to the next tier
+#: (replaces the reference's RSS-watermark `max_memory_per_worker`=512MB,
+#: settings.py:27 + memory.py — our block sizes are known, so accounting is
+#: deterministic, no /proc sampling).
+max_memory_per_stage = 512 * 1024 * 1024
+
+# ---------------------------------------------------------------------------
+# TPU-native knobs (no reference analog)
+# ---------------------------------------------------------------------------
+
+#: Mesh axis name used for data-parallel sharding of record batches.
+mesh_axis = "shards"
+
+#: When True, keyed kernels (hash/sort/segment-reduce) run through JAX on the default
+#: backend; when False everything uses the numpy host fallback (useful for debugging).
+use_device = os.environ.get("DAMPR_TPU_USE_DEVICE", "1") not in ("0", "false")
+
+#: Minimum records in a block before device dispatch is worth it; smaller
+#: blocks take the numpy path to dodge dispatch overhead.  None = resolve by
+#: transport: in-process backends (cpu) dispatch cheaply at 4096; a
+#: locally-attached accelerator needs larger batches to amortize transfer;
+#: a remote-tunnel attachment (detected via the tunnel env) only pays off
+#: for multi-million-record batches.  Set an int to pin it.
+device_min_batch = (int(os.environ["DAMPR_TPU_DEVICE_MIN_BATCH"])
+                    if os.environ.get("DAMPR_TPU_DEVICE_MIN_BATCH") else None)
+
+#: Every auto-resolved threshold is at least this, so batches below it decide
+#: "host" without touching (or initializing) any JAX backend.
+_MIN_BATCH_FLOOR = 4096
+
+_resolved_min_batch = None
+
+
+def effective_device_min_batch():
+    global _resolved_min_batch
+    if device_min_batch is not None:
+        return device_min_batch
+    if _resolved_min_batch is None:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            _resolved_min_batch = 4096
+        elif os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+            _resolved_min_batch = 1 << 22
+        else:
+            _resolved_min_batch = 1 << 16
+    return _resolved_min_batch
+
+
+def use_device_for(n):
+    """Device-dispatch decision for an n-record batch.  Small batches answer
+    without resolving the backend (no accidental JAX initialization)."""
+    if not use_device or n < _MIN_BATCH_FLOOR:
+        return False
+    return n >= effective_device_min_batch()
+
+#: Use the Pallas TPU kernel for batched string hashing (ops/pallas_fnv.py):
+#: keeps both FNV lanes VMEM-resident across the whole byte scan.  Off by
+#: default — on locally-attached TPUs it wins; through a remote-transfer
+#: tunnel the widened input upload dominates.
+use_pallas = os.environ.get("DAMPR_TPU_PALLAS", "0") in ("1", "true")
+
+#: Capacity slack factor for the fixed-shape all_to_all shuffle exchange
+#: (MoE-style capacity: per-(src,dst) buffer = ceil(N/D) * factor).
+shuffle_capacity_factor = 1.5
+
+#: Route device-foldable associative reduces through the mesh collective
+#: shuffle (local fold -> all_to_all -> final fold) instead of per-partition
+#: host jobs: "auto" = when more than one device is visible, "on", "off".
+#: Falls back to the host path whenever exactness can't be guaranteed
+#: (object values, 32-bit lane overflow, 64-bit key collisions).
+mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
+
+#: Route the *general* shuffle — non-associative group_by reduces, joins —
+#: through the mesh byte exchange (parallel/exchange.py): every input
+#: partition's blocks cross a fixed-shape all_to_all, windowed under the run
+#: budget, with partition pid resident on device pid % D (co-partitioning
+#: preserved for joins by construction).  "auto" = when more than one device
+#: is visible, "on", "off".  The associative-numeric fast path (mesh_fold)
+#: takes precedence where it applies.
+mesh_exchange = os.environ.get("DAMPR_TPU_MESH_EXCHANGE", "auto")
+
+#: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
+#: base.py:435-469).
+scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")
+
+#: Per-job retry budget for transient failures (flaky IO/UDF): a failing map/
+#: reduce/sink job re-executes up to this many times before the run fails
+#: fast with the original traceback.  The reference deadlocks on a dead
+#: worker (stagerunner.py:35-38); 0 keeps plain fail-fast.
+job_retries = 0
+
+#: When set, every run is wrapped in a jax.profiler trace written under this
+#: directory (view with TensorBoard / xprof).  Structured per-stage metrics
+#: are always available via ValueEmitter.stats regardless.
+profile_dir = os.environ.get("DAMPR_TPU_PROFILE_DIR") or None
+
+#: Partition-size threshold (bytes) above which a single-input reduce streams
+#: a k-way merge over hash-sorted runs instead of materializing the partition
+#: (groups then arrive in hash order, not key order).  None = use
+#: max_memory_per_stage.
+streaming_reduce_threshold = None

@@ -1,0 +1,270 @@
+"""Out-of-core storage: block refs, spill tiers, scratch layout, accounting.
+
+Replaces the reference's disk-spill machinery — RSS-watermark writers
+(dampr/dataset.py:119-262, memory.py) and the /tmp/<job>/stage_N scratch tree
+(base.py:435-469) — with deterministic byte accounting: block sizes are known
+exactly, so no /proc sampling is needed.  The tier order is RAM → disk
+(HBM-resident arrays are transient inside kernels; host RAM is the working
+tier, gzip'd pickle files the spill tier).
+
+Every stage output lives behind :class:`BlockRef`; the per-run
+:class:`RunStore` decides which refs stay hot.  ``pin=True`` refs (``cached()``
+stages) never spill.
+"""
+
+import contextlib
+import gzip
+import logging
+import os
+import pickle
+import shutil
+import threading
+import uuid
+
+from . import settings
+
+log = logging.getLogger("dampr_tpu.storage")
+
+
+class BlockRef(object):
+    """A handle to one materialized block: RAM-resident or spilled to disk."""
+
+    __slots__ = ("_block", "path", "nbytes", "nrecords", "value_dtype",
+                 "key_dtype", "store", "pin")
+
+    def __init__(self, block, store=None, pin=False):
+        self._block = block
+        self.path = None
+        self.nbytes = block.nbytes()
+        self.nrecords = len(block)
+        self.value_dtype = block.values.dtype  # metadata survives spilling
+        self.key_dtype = block.keys.dtype
+        self.store = store
+        self.pin = pin
+
+    def __len__(self):
+        return self.nrecords
+
+    @property
+    def resident(self):
+        return self._block is not None
+
+    def get(self):
+        blk = self._block
+        if blk is None:
+            blk = load_block(self.path)
+            # Do not re-cache: reduce jobs stream partitions one at a time and
+            # re-residency would defeat the memory bound.
+        return blk
+
+    def iter_windows(self):
+        """Stream the block in bounded windows without materializing it
+        whole (resident blocks yield array-view slices)."""
+        blk = self._block
+        if blk is None:
+            for w in iter_block_windows(self.path):
+                yield w
+            return
+        from .blocks import Block
+
+        n = len(blk)
+        for at in range(0, n, SPILL_WINDOW):
+            end = min(at + SPILL_WINDOW, n)
+            yield Block(
+                blk.keys[at:end], blk.values[at:end],
+                None if blk.h1 is None else blk.h1[at:end],
+                None if blk.h2 is None else blk.h2[at:end])
+
+    def spill(self, directory):
+        if self._block is None or self.pin:
+            return 0
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, uuid.uuid4().hex + ".blk")
+        save_block(self._block, self.path)
+        freed = self.nbytes
+        self._block = None
+        return freed
+
+    def delete(self):
+        self._block = None
+        if self.path and os.path.exists(self.path):
+            os.unlink(self.path)
+            self.path = None
+
+
+#: Records per spill window: the unit of streamed re-reads.  Bounded so a
+#: k-way merge holds k windows, never k whole blocks.
+SPILL_WINDOW = 16384
+
+
+def save_block(block, path):
+    """Spill wire format: a sequence of pickled columnar windows inside one
+    gzip stream.  Windowing keeps spilled blocks *streamable* — merge readers
+    hold one window per run — while numeric lanes still serialize as raw
+    buffers (pickle protocol 5); same gzip+pickle tradeoff as the reference's
+    batched streams (dataset.py:20-41) but columnar."""
+    n = len(block)
+    with gzip.open(path, "wb", compresslevel=settings.compress_level) as f:
+        for at in range(0, max(n, 1), SPILL_WINDOW):
+            end = min(at + SPILL_WINDOW, n)
+            pickle.dump(
+                (block.keys[at:end], block.values[at:end],
+                 None if block.h1 is None else block.h1[at:end],
+                 None if block.h2 is None else block.h2[at:end]),
+                f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def iter_block_windows(path):
+    """Stream a spilled block back window by window (bounded memory)."""
+    from .blocks import Block
+
+    with gzip.open(path, "rb") as f:
+        while True:
+            try:
+                keys, values, h1, h2 = pickle.load(f)
+            except EOFError:
+                return
+            yield Block(keys, values, h1, h2)
+
+
+def load_block(path):
+    from .blocks import Block
+
+    return Block.concat(list(iter_block_windows(path)))
+
+
+class RunStore(object):
+    """Per-run block registry with a byte budget (the memory-governor analog).
+
+    Tracks every RAM-resident ref; when residency exceeds
+    ``settings.max_memory_per_stage`` the oldest unpinned refs spill to the
+    run's scratch directory.  Thread-safe — map jobs register refs
+    concurrently.
+    """
+
+    def __init__(self, name, budget=None):
+        safe = name.replace("/", "_")
+        self.root = os.path.join(settings.scratch_root, safe)
+        self.budget = settings.max_memory_per_stage if budget is None else budget
+        self._lock = threading.Lock()
+        self._resident = []          # FIFO of RAM refs
+        self._resident_bytes = 0
+        self._stage = "stage_0"
+        self._attempts = threading.local()
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    @contextlib.contextmanager
+    def attempt(self):
+        """Track every ref this thread registers inside the block; on
+        exception the refs are dropped, so a retried job's failed attempt
+        cannot orphan blocks against the memory budget."""
+        stack = getattr(self._attempts, "stack", None)
+        if stack is None:
+            stack = self._attempts.stack = []
+        refs = []
+        stack.append(refs)
+        try:
+            yield refs
+        except BaseException:
+            for ref in refs:
+                self.drop_ref(ref)
+            raise
+        finally:
+            stack.pop()
+
+    def set_stage(self, stage_name):
+        self._stage = "stage_{}".format(stage_name)
+
+    def register(self, block, pin=False):
+        ref = BlockRef(block, store=self, pin=pin)
+        stack = getattr(self._attempts, "stack", None)
+        if stack:
+            stack[-1].append(ref)
+        with self._lock:
+            self._resident.append(ref)
+            self._resident_bytes += ref.nbytes
+            victims = self._select_victims_locked()
+        # Spill I/O happens OUTSIDE the lock: victims are already removed from
+        # the resident list (each ref is selected exactly once), so concurrent
+        # workers keep registering while gzip+write proceeds here.
+        if victims:
+            directory = os.path.join(self.root, self._stage)
+            freed = 0
+            for v in victims:
+                freed += v.spill(directory)
+            with self._lock:
+                self.spill_count += len(victims)
+                self.spilled_bytes += freed
+        return ref
+
+    def _select_victims_locked(self):
+        """Pick oldest unpinned refs until projected residency meets the
+        budget; deduct their bytes immediately so other threads see the
+        budget as already relieved."""
+        if self._resident_bytes <= self.budget:
+            return []
+        victims = []
+        keep = []
+        for ref in self._resident:
+            if (self._resident_bytes > self.budget and not ref.pin
+                    and ref.resident):
+                victims.append(ref)
+                self._resident_bytes -= ref.nbytes
+            else:
+                keep.append(ref)
+        self._resident = keep
+        if self._resident_bytes > self.budget:
+            log.warning(
+                "RunStore over budget even after spilling (%d > %d bytes) — "
+                "pinned blocks exceed the memory budget",
+                self._resident_bytes, self.budget)
+        return victims
+
+    def drop_ref(self, ref):
+        with self._lock:
+            if ref in self._resident:
+                self._resident.remove(ref)
+                self._resident_bytes -= ref.nbytes
+        ref.delete()
+
+    def cleanup(self):
+        """Remove the run's scratch tree (outputs the caller wants to keep
+        must have been read or re-registered elsewhere first)."""
+        if os.path.isdir(self.root):
+            shutil.rmtree(self.root, ignore_errors=True)
+
+
+class PartitionSet(object):
+    """The stage-exchange format: {partition_id: [BlockRef]} — the engine
+    analog of the reference's {partition: [Dataset]} dicts
+    (base.py:416-433, runner.py:163-172)."""
+
+    __slots__ = ("parts", "n_partitions")
+
+    def __init__(self, n_partitions):
+        self.parts = {}
+        self.n_partitions = n_partitions
+
+    def add(self, pid, ref):
+        self.parts.setdefault(pid, []).append(ref)
+
+    def refs(self, pid):
+        return self.parts.get(pid, [])
+
+    def all_refs(self):
+        for pid in sorted(self.parts):
+            for ref in self.parts[pid]:
+                yield ref
+
+    def total_records(self):
+        return sum(len(r) for r in self.all_refs())
+
+    def delete(self, store=None):
+        for refs in self.parts.values():
+            for ref in refs:
+                if store is not None:
+                    store.drop_ref(ref)
+                else:
+                    ref.delete()
+        self.parts = {}

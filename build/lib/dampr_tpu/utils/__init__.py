@@ -1,0 +1,4 @@
+from .common import filter_by_count
+from .indexer import Indexer
+
+__all__ = ["Indexer", "filter_by_count"]

@@ -1,0 +1,17 @@
+"""Composed-DSL utilities (reference dampr/utils/common.py)."""
+
+
+def filter_by_count(pipe, key_func, filter_func):
+    """Keep items whose key's global count passes ``filter_func`` — the
+    canonical count-then-join-back composition (reference utils/common.py:2-15).
+    The count compiles to a device segment-sum; the join is co-partitioned
+    sort-merge.
+    """
+    item_count = (pipe.map(key_func)
+                  .count()
+                  .filter(lambda count: filter_func(count[1])))
+
+    return (item_count.group_by(lambda x: x[0], lambda x: x[1])
+            .join(pipe.group_by(key_func))
+            .reduce(lambda _lit, rit: rit, many=True)
+            .map(lambda x: x[1]))

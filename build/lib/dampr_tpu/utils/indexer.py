@@ -1,0 +1,115 @@
+"""SQLite inverted index over line files (reference dampr/utils/indexer.py).
+
+``build`` runs a Dampr pipeline that writes a hidden ``.<name>.index`` SQLite
+DB per input file mapping keys to byte offsets; ``union``/``intersect`` stream
+back the matching lines by seeking.  Offsets here are byte offsets (binary
+seek), making lookups exact regardless of encoding.
+"""
+
+import logging
+import os
+import sqlite3
+
+from ..dampr import Dampr
+from ..inputs import read_paths
+
+log = logging.getLogger("dampr_tpu.indexer")
+
+
+class Indexer(object):
+    def __init__(self, path, suffix=".index"):
+        self.path = path
+        self.suffix = suffix
+
+    def get_idx(self, path):
+        dirname, base = os.path.split(path)
+        return os.path.join(dirname, "." + base + self.suffix)
+
+    def exists(self, path):
+        return os.path.isfile(self.get_idx(path))
+
+    def _open_db(self, path, delete=False):
+        idx = self.get_idx(path)
+        if delete and os.path.isfile(idx):
+            os.unlink(idx)
+        return sqlite3.connect(idx)
+
+    def _create_db(self, path):
+        db = self._open_db(path, delete=True)
+        db.cursor().execute(
+            "CREATE TABLE key_index (key text, offset integer)")
+        return db
+
+    def build(self, key_f, force=False):
+        """Index every file under ``path``: ``key_f(line) -> iterable of
+        keys``.  Returns total keys indexed."""
+        paths = sorted(read_paths(self.path, False))
+
+        def index_file(fname):
+            log.debug("Indexing %s", fname)
+            db = self._create_db(fname)
+
+            def it():
+                offset = 0
+                with open(fname, "rb") as f:
+                    for raw in f:
+                        line = raw.decode("utf-8")
+                        for key in key_f(line):
+                            yield key, offset
+                        offset += len(raw)
+
+            c = db.cursor()
+            c.executemany("INSERT INTO key_index values (?, ?)", it())
+            db.commit()
+            c.execute("create index key_idx on key_index (key)")
+            db.commit()
+            c.execute("select count(*) from key_index")
+            count = c.fetchone()[0]
+            db.close()
+            return count
+
+        return (Dampr.memory(paths)
+                .filter(lambda fname: force or not self.exists(fname))
+                .map(index_file)
+                .fold_by(key=lambda _x: 1, binop=lambda x, y: x + y)
+                .read(name="indexing"))
+
+    def _seek_lines(self, query, params):
+        params = tuple(params)
+
+        def read_db(fname):
+            db = self._open_db(fname)
+            cur = db.cursor()
+            cur.execute(query, params)
+            with open(fname, "rb") as f:
+                for (offset,) in cur:
+                    f.seek(offset)
+                    yield f.readline().decode("utf-8")
+            db.close()
+
+        paths = sorted(read_paths(self.path, False))
+        return Dampr.memory(paths).flat_map(read_db)
+
+    def union(self, keys):
+        """Lines containing any of the keys."""
+        if not isinstance(keys, (list, tuple)):
+            keys = [keys]
+        query = ("select distinct offset from key_index where key in ({}) "
+                 "order by offset asc").format(
+                     ",".join("?" for _ in keys))
+        return self._seek_lines(query, keys)
+
+    def intersect(self, keys, min_match=None):
+        """Lines containing at least ``min_match`` of the keys (all, by
+        default; a float is a fraction of the key count)."""
+        if not isinstance(keys, (list, tuple)):
+            keys = [keys]
+        if min_match is None:
+            min_match = len(keys)
+        if isinstance(min_match, float):
+            min_match = int(min_match * len(keys))
+        query = ("select offset from (select offset, count(*) as c from "
+                 "key_index where key in ({}) group by offset) where c >= ? "
+                 "order by offset asc").format(
+                     ",".join("?" for _ in keys))
+        return self._seek_lines(query, list(keys) + [min_match])
