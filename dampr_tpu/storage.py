@@ -27,20 +27,30 @@ log = logging.getLogger("dampr_tpu.storage")
 
 
 class BlockRef(object):
-    """A handle to one materialized block: RAM-resident or spilled to disk."""
+    """A handle to one materialized block: RAM-resident, compressed-in-RAM
+    (pinned ``cached()`` blocks — the reference's MemGZipDataset tier,
+    dampr/dataset.py:528-547), or spilled to disk."""
 
-    __slots__ = ("_block", "path", "nbytes", "nrecords", "value_dtype",
-                 "key_dtype", "store", "pin")
+    __slots__ = ("_block", "_packed", "path", "nbytes", "nrecords",
+                 "value_dtype", "key_dtype", "store", "pin")
 
     def __init__(self, block, store=None, pin=False):
-        self._block = block
-        self.path = None
-        self.nbytes = block.nbytes()
+        self._packed = None
         self.nrecords = len(block)
         self.value_dtype = block.values.dtype  # metadata survives spilling
         self.key_dtype = block.keys.dtype
         self.store = store
         self.pin = pin
+        self.path = None
+        if pin:
+            # cached() semantics: compressed RAM, charged at compressed size
+            # (never spilled to disk, decompressed per read).
+            self._block = None
+            self._packed = pack_block(block)
+            self.nbytes = len(self._packed)
+        else:
+            self._block = block
+            self.nbytes = block.nbytes()
 
     def __len__(self):
         return self.nrecords
@@ -52,6 +62,8 @@ class BlockRef(object):
     def get(self):
         blk = self._block
         if blk is None:
+            if self._packed is not None:
+                return unpack_block(self._packed)
             blk = load_block(self.path)
             # Do not re-cache: reduce jobs stream partitions one at a time and
             # re-residency would defeat the memory bound.
@@ -62,9 +74,11 @@ class BlockRef(object):
         whole (resident blocks yield array-view slices)."""
         blk = self._block
         if blk is None:
-            for w in iter_block_windows(self.path):
-                yield w
-            return
+            if self._packed is None:
+                for w in iter_block_windows(self.path):
+                    yield w
+                return
+            blk = unpack_block(self._packed)
         from .blocks import Block
 
         n = len(blk)
@@ -87,6 +101,7 @@ class BlockRef(object):
 
     def delete(self):
         self._block = None
+        self._packed = None
         if self.path and os.path.exists(self.path):
             os.unlink(self.path)
             self.path = None
@@ -131,6 +146,28 @@ def load_block(path):
     from .blocks import Block
 
     return Block.concat(list(iter_block_windows(path)))
+
+
+def pack_block(block):
+    """Compress a block into RAM bytes (the ``cached()`` tier)."""
+    import io
+
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb",
+                       compresslevel=settings.compress_level) as f:
+        pickle.dump((block.keys, block.values, block.h1, block.h2), f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def unpack_block(data):
+    import io
+
+    from .blocks import Block
+
+    with gzip.GzipFile(fileobj=io.BytesIO(data), mode="rb") as f:
+        keys, values, h1, h2 = pickle.load(f)
+    return Block(keys, values, h1, h2)
 
 
 class RunStore(object):
@@ -215,10 +252,14 @@ class RunStore(object):
                 keep.append(ref)
         self._resident = keep
         if self._resident_bytes > self.budget:
-            log.warning(
-                "RunStore over budget even after spilling (%d > %d bytes) — "
-                "pinned blocks exceed the memory budget",
-                self._resident_bytes, self.budget)
+            # Everything unpinned has spilled; what remains is cached()
+            # data, already gzip-compressed in RAM.  The reference would
+            # keep allocating until the OS kills it; fail loudly instead.
+            raise MemoryError(
+                "cached() blocks exceed the memory budget even compressed "
+                "({} > {} bytes); raise the budget or drop a cached()/"
+                "memory=True stage".format(
+                    self._resident_bytes, self.budget))
         return victims
 
     def drop_ref(self, ref):

@@ -1,0 +1,55 @@
+"""The cached() tier: pinned blocks live gzip-compressed in RAM (the
+reference's MemGZipDataset semantics, dampr/dataset.py:528-547), are charged
+against the budget at compressed size, and over-budget pinning fails loudly
+instead of silently blowing past the budget."""
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.blocks import Block
+from dampr_tpu.storage import RunStore
+
+
+def _big_block(n=20000):
+    keys = np.arange(n, dtype=np.int64)
+    vals = np.zeros(n, dtype=np.int64)  # compresses well
+    return Block(keys, vals)
+
+
+class TestCompressedPinned:
+    def test_pinned_ref_is_compressed_and_round_trips(self):
+        store = RunStore("cached-tier", budget=1 << 30)
+        blk = _big_block()
+        ref = store.register(blk, pin=True)
+        assert ref.nbytes < blk.nbytes() // 4  # compressed charge
+        got = ref.get()
+        np.testing.assert_array_equal(got.keys, blk.keys)
+        np.testing.assert_array_equal(got.values, blk.values)
+        # windows stream from the packed copy too
+        n = sum(len(w) for w in ref.iter_windows())
+        assert n == len(blk)
+
+    def test_pinned_never_spills(self, tmp_path):
+        # budget holds the (tiny, compressed) pinned block but nothing else
+        store = RunStore("cached-nospill", budget=8192)
+        ref = store.register(Block.from_pairs([(1, 2)] * 100), pin=True)
+        unpinned = store.register(_big_block(), pin=False)
+        assert not unpinned.resident  # spilled to meet the 1-byte budget
+        assert ref.path is None  # pinned stayed in (compressed) RAM
+        assert dict(ref.get().iter_pairs()) == {1: 2}
+
+    def test_over_budget_pinning_raises(self):
+        store = RunStore("cached-hardfail", budget=1024)
+        rng = np.random.RandomState(0)
+        incompressible = Block(
+            np.arange(50000, dtype=np.int64),
+            rng.randint(-2 ** 62, 2 ** 62, size=50000))
+        with pytest.raises(MemoryError, match="cached"):
+            store.register(incompressible, pin=True)
+
+    def test_cached_pipeline_still_exact(self):
+        data = list(range(500))
+        pipe = Dampr.memory(data, partitions=4).map(lambda x: x * 2).cached()
+        out = sorted(pipe.run().read())
+        assert out == [x * 2 for x in data]
