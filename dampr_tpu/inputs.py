@@ -1,44 +1,312 @@
-"""Input taps: how bytes enter the framework.
+"""Ingest: chunk planning, readahead, and splittable compressed taps.
 
-Parity surface: reference dampr/inputs.py — ``read_paths`` glob/walk with
-dotfile filtering (14-30), ``PathInput`` (32-41), ``TextInput`` byte-range
-chunking with .gz-as-one-chunk (43-56), ``MemoryInput`` (59-71),
-``UrlsInput``/``UrlDataset`` with skip-on-error (74-97).
+Redesigned in round 3 as a *planner + prefetcher*, not per-file generator
+nesting:
 
-Taps are host-side by design: IO and decompression happen on CPU threads; the
-records they emit are batched into columnar blocks downstream, which is where
-the TPU path begins.
+1. **Chunk planning** (:func:`plan_chunks`): one scandir-based walk produces
+   every chunk spec up front.  File sizes come free from ``DirEntry`` (one
+   ``getdents`` batch per directory instead of a stat round-trip per file),
+   ordering is fully deterministic (names sorted at every level), and the
+   container format is sniffed from magic bytes, not the extension — a
+   mis-named uncompressed ``.gz`` splits like the text file it is.
+2. **Splittable gzip** (BGZF): blocked-gzip files (bgzip/htslib framing —
+   concatenated gzip members carrying their compressed size in a ``BC``
+   extra subfield) are split at member boundaries into parallel chunks,
+   each with the same line-boundary contract as byte-range text chunks.
+   Plain gzip streams remain one unsplittable chunk.
+3. **Readahead** (:class:`Readahead`): a bounded background prefetcher
+   loads the next chunks' bytes (file read + gzip inflate, both of which
+   release the GIL) while the current chunk computes.  It starts lazily on
+   the first ``read_bytes`` call, so per-record consumers never pay for it.
+4. **Byte-first taps**: every planned chunk exposes ``read_bytes()``, so
+   the native tokenizer codec consumes raw buffers straight from the tap
+   (bytes -> token blocks with no intermediate str lines).
+
+Parity surface (public names unchanged): ``read_paths``, ``PathInput``,
+``TextInput``, ``MemoryInput``, ``UrlsInput`` — the capability set of
+reference dampr/inputs.py, re-architected.
 """
 
+import collections
 import glob
 import os
+import threading
+import zlib
 from contextlib import closing
 
-from .dataset import (Chunker, Dataset, GzipLineDataset, MemoryDataset,
+from . import settings
+from .dataset import (Dataset, Chunker, GzipLineDataset, MemoryDataset,
                       TextLineDataset)
+
+# ---------------------------------------------------------------------------
+# Planning
+# ---------------------------------------------------------------------------
+
+#: One planned unit of ingest work.  ``kind`` is "text" (byte range),
+#: "gzip" (whole unsplittable stream), or "bgzf" (member-aligned compressed
+#: range).  ``start``/``end`` are byte offsets into the file as stored.
+ChunkSpec = collections.namedtuple("ChunkSpec", "path start end kind size")
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def _scan_tree(root, follow_links):
+    """Depth-first scandir walk: yields (path, size) for every visible file,
+    names sorted at every level, sizes from the DirEntry stat cache."""
+    try:
+        entries = sorted(os.scandir(root), key=lambda e: e.name)
+    except NotADirectoryError:
+        yield root, os.stat(root).st_size
+        return
+    except OSError:
+        return  # broken symlink / vanished path: yield nothing (old
+        #         os.walk behavior), never kill the whole ingest
+    dirs = []
+    for e in entries:
+        if e.name.startswith("."):
+            continue
+        try:
+            if e.is_file(follow_symlinks=True):
+                yield e.path, e.stat(follow_symlinks=True).st_size
+            elif e.is_dir(follow_symlinks=follow_links):
+                dirs.append(e.path)
+        except OSError:
+            continue  # vanished between scandir and stat
+    for d in dirs:
+        for item in _scan_tree(d, follow_links):
+            yield item
+
+
+def iter_files(paths, follow_links=True):
+    """Expand globs / walk directories; hide dotfiles; yield (path, size)."""
+    if not isinstance(paths, list):
+        paths = [paths]
+    for path_glob in paths:
+        for path in sorted(glob.glob(path_glob)):
+            if os.path.isfile(path):
+                yield path, os.stat(path).st_size
+            else:
+                for item in _scan_tree(path, follow_links):
+                    yield item
 
 
 def read_paths(paths, follow_links=True):
-    """Expand globs; walk directories; hide dotfiles."""
-    if not isinstance(paths, list):
-        paths = [paths]
+    """Parity helper: just the paths from :func:`iter_files`."""
+    return (p for p, _size in iter_files(paths, follow_links))
 
-    def it():
-        for path_glob in paths:
-            for path in sorted(glob.glob(path_glob)):
-                if os.path.isfile(path):
-                    yield path
-                else:
-                    for root, _dirs, files in os.walk(
-                            path, followlinks=follow_links):
-                        for fname in sorted(files):
-                            yield os.path.join(root, fname)
 
-    return (p for p in it() if not os.path.basename(p).startswith("."))
+def _sniff(path):
+    """Classify a file by magic bytes: 'text', 'gzip', or 'bgzf'."""
+    with open(path, "rb") as f:
+        hdr = f.read(18)
+    if len(hdr) < 18 or hdr[:2] != _GZIP_MAGIC:
+        return "text"
+    flg = hdr[3]
+    if flg & 4:  # FEXTRA
+        xlen = int.from_bytes(hdr[10:12], "little")
+        # BGZF fixes exactly one subfield: SI 'BC', SLEN 2, at the front.
+        if (xlen >= 6 and hdr[12:14] == b"BC"
+                and int.from_bytes(hdr[14:16], "little") == 2):
+            return "bgzf"
+    return "gzip"
+
+
+def _bgzf_member_size(f, off):
+    """Size of the BGZF member at ``off`` (or None at EOF / bad framing)."""
+    f.seek(off)
+    hdr = f.read(18)
+    if len(hdr) < 18 or hdr[:2] != _GZIP_MAGIC or hdr[12:14] != b"BC":
+        return None
+    return int.from_bytes(hdr[16:18], "little") + 1
+
+
+def _load_gzi(path):
+    """Block offsets from a bgzip ``.gzi`` index, if one ships alongside
+    (uint64 count, then (compressed, uncompressed) offset pairs per block
+    after the first).  Saves the member walk entirely on indexed corpora."""
+    gzi = path + ".gzi"
+    try:
+        with open(gzi, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    if len(data) < 8:
+        return None
+    n = int.from_bytes(data[:8], "little")
+    if len(data) < 8 + 16 * n:
+        return None
+    offs = [0]
+    for k in range(n):
+        offs.append(int.from_bytes(data[8 + 16 * k: 16 + 16 * k], "little"))
+    return offs
+
+
+def _bgzf_boundaries(path, size, chunk_size):
+    """Member-aligned chunk boundaries: from the ``.gzi`` index when
+    present, else one seek + 18-byte header read per member (16 bytes of
+    plan IO per ~64KB of data; ship a .gzi for very large corpora).
+    Returns None when the stream stops parsing as BGZF before ``size`` —
+    e.g. a trailing plain-gzip member in a concatenated file — so the
+    caller falls back to one whole-stream chunk and loses nothing."""
+    offs = _load_gzi(path)
+    if offs is not None:
+        bounds = [0]
+        acc = 0
+        for a, b in zip(offs, offs[1:] + [size]):
+            acc += b - a
+            if acc >= chunk_size and b < size:
+                bounds.append(b)
+                acc = 0
+        bounds.append(size)
+        return bounds
+    bounds = [0]
+    with open(path, "rb") as f:
+        off = 0
+        acc = 0
+        while off < size:
+            msize = _bgzf_member_size(f, off)
+            if msize is None:
+                return None  # not BGZF all the way: caller must not split
+            off += msize
+            acc += msize
+            if acc >= chunk_size and off < size:
+                bounds.append(off)
+                acc = 0
+    bounds.append(size)
+    return bounds
+
+
+def plan_file(path, size, chunk_size):
+    """Chunk specs for one file, splitting where the format allows."""
+    kind = _sniff(path) if size else "text"
+    if kind == "bgzf":
+        bounds = _bgzf_boundaries(path, size, chunk_size)
+        if bounds is None or len(bounds) < 2:
+            kind = "gzip"
+        else:
+            return [ChunkSpec(path, a, b, "bgzf", size)
+                    for a, b in zip(bounds, bounds[1:]) if b > a]
+    if kind == "gzip":
+        return [ChunkSpec(path, 0, size, "gzip", size)]
+    return [ChunkSpec(path, at, min(at + chunk_size, size), "text", size)
+            for at in range(0, max(size, 1), chunk_size)]
+
+
+def plan_chunks(paths, chunk_size, follow_links=True):
+    """The full ingest plan: every chunk of every matched file."""
+    specs = []
+    for path, size in iter_files(paths, follow_links):
+        specs.extend(plan_file(path, size, chunk_size))
+    return specs
+
+
+def _spec_dataset(spec):
+    if spec.kind == "gzip":
+        return GzipLineDataset(spec.path)
+    if spec.kind == "bgzf":
+        return BgzfChunkDataset(spec.path, spec.start, spec.end, spec.size)
+    return TextLineDataset(spec.path, spec.start,
+                           None if spec.end >= spec.size else spec.end)
+
+
+# ---------------------------------------------------------------------------
+# Readahead
+# ---------------------------------------------------------------------------
+
+
+class Readahead(object):
+    """Bounded background prefetcher over an ordered list of byte loaders.
+
+    One daemon thread walks the loaders in plan order, holding at most
+    ``depth`` unconsumed buffers (a semaphore slot per buffer).  Consumers
+    call :meth:`take`; an index the thread hasn't reached (or is mid-load
+    on) is claimed and loaded directly by the consumer, so out-of-order
+    consumption can never deadlock — at worst one chunk is read twice.
+    The thread starts lazily on the first ``take``, so pipelines that never
+    touch ``read_bytes`` (pure per-record paths) pay nothing.
+    """
+
+    def __init__(self, loaders, depth=2):
+        self._loaders = loaders
+        self._sem = threading.Semaphore(max(1, depth))
+        self._lock = threading.Lock()
+        self._results = {}
+        self._claimed = set()
+        self._events = [threading.Event() for _ in loaders]
+        self._inflight = None
+        self._started = False
+
+    def _run(self):
+        for i, load in enumerate(self._loaders):
+            self._sem.acquire()
+            with self._lock:
+                if i in self._claimed:
+                    self._sem.release()
+                    continue
+                self._inflight = i
+            try:
+                data = load()
+            except BaseException as e:  # delivered to the consumer
+                data = e
+            with self._lock:
+                self._inflight = None
+                self._results[i] = data
+            self._events[i].set()
+
+    def _pop(self, i):
+        with self._lock:
+            data = self._results.pop(i)
+            self._sem.release()
+        if isinstance(data, BaseException):
+            raise data
+        return data
+
+    def take(self, i):
+        wait = False
+        with self._lock:
+            if not self._started:
+                self._started = True
+                threading.Thread(target=self._run, daemon=True,
+                                 name="dampr-tpu-readahead").start()
+            if i in self._results:
+                wait = True  # ready now; pop below, outside this block
+            elif self._inflight == i:
+                wait = True  # mid-load: wait for it, never load twice
+            else:
+                self._claimed.add(i)
+        if wait:
+            self._events[i].wait()
+            return self._pop(i)
+        return self._loaders[i]()
+
+
+class PrefetchedChunk(object):
+    """A planned chunk whose ``read_bytes`` is served by the shared
+    :class:`Readahead`; everything else delegates to the inner dataset."""
+
+    def __init__(self, inner, readahead, index):
+        self._inner = inner
+        self._readahead = readahead
+        self._index = index
+
+    def read_bytes(self):
+        return self._readahead.take(self._index)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return "Prefetched[{!r}]".format(self._inner)
+
+
+# ---------------------------------------------------------------------------
+# Taps (parity surface)
+# ---------------------------------------------------------------------------
 
 
 class PathInput(Chunker):
-    """File / directory / glob of newline-delimited text."""
+    """File / directory / glob of newline-delimited text, planned up front
+    and served through the readahead window."""
 
     def __init__(self, path, chunk_size=64 * 1024 ** 2, follow_links=True):
         self.path = path
@@ -46,29 +314,120 @@ class PathInput(Chunker):
         self.follow_links = follow_links
 
     def chunks(self):
-        for path in read_paths(self.path, self.follow_links):
-            for c in TextInput(path, self.chunk_size).chunks():
-                yield c
+        specs = plan_chunks(self.path, self.chunk_size, self.follow_links)
+        datasets = [_spec_dataset(s) for s in specs]
+        depth = settings.readahead_chunks
+        if depth and len(datasets) > 1:
+            ra = Readahead([ds.read_bytes for ds in datasets], depth)
+            datasets = [PrefetchedChunk(ds, ra, i)
+                        for i, ds in enumerate(datasets)]
+        for ds in datasets:
+            yield ds
 
 
 class TextInput(Chunker):
-    """One text file split into byte-range chunks; .gz files are a single
-    unsplittable chunk (gzip streams have no random access)."""
+    """One file's chunks (format sniffed from magic bytes, no readahead)."""
 
     def __init__(self, path, chunk_size=64 * 1024 ** 2):
         self.path = path
         self.chunk_size = chunk_size
 
     def chunks(self):
-        if self.path.endswith(".gz"):
-            yield GzipLineDataset(self.path)
-        else:
-            file_size = os.stat(self.path).st_size
-            offset = 0
-            while offset < file_size:
-                yield TextLineDataset(self.path, offset,
-                                      offset + self.chunk_size)
-                offset += self.chunk_size
+        size = os.stat(self.path).st_size
+        for spec in plan_file(self.path, size, self.chunk_size):
+            yield _spec_dataset(spec)
+
+
+class BgzfChunkDataset(Dataset):
+    """A member-aligned compressed range ``[start, end)`` of a BGZF file.
+
+    Line-boundary contract — the decompressed-stream mirror of
+    :class:`~dampr_tpu.dataset.TextLineDataset`'s byte-range rules: a chunk
+    with ``start > 0`` drops everything up to and including the first
+    newline of its own decompressed range; every chunk that doesn't end the
+    file keeps decompressing subsequent members through the line that
+    crosses its boundary.  Adjacent chunks therefore read every line
+    exactly once, and a chunk whose entire range is one partial line owns
+    nothing (that line belongs to its left neighbor).
+    """
+
+    def __init__(self, path, start, end, file_size):
+        self.path = path
+        self.start = start
+        self.end = end
+        self.file_size = file_size
+
+    @staticmethod
+    def _inflate(raw):
+        """Decompress concatenated gzip members.  BGZF members are hopped by
+        their BSIZE field so each inflate sees exactly one member — the
+        naive unused_data chain would copy the whole remaining buffer per
+        member, O(members * bytes).  Non-BGZF members (possible only via a
+        corrupt index) fall back to the generic chain for the tail."""
+        out = []
+        mv = memoryview(raw)
+        off, n = 0, len(raw)
+        while off < n:
+            if (n - off >= 18 and bytes(mv[off:off + 2]) == _GZIP_MAGIC
+                    and bytes(mv[off + 12:off + 14]) == b"BC"):
+                msize = int.from_bytes(mv[off + 16:off + 18], "little") + 1
+                dec = zlib.decompressobj(wbits=31)
+                out.append(dec.decompress(mv[off:off + msize]))
+                off += msize
+            else:
+                data = bytes(mv[off:])
+                while data:
+                    dec = zlib.decompressobj(wbits=31)
+                    out.append(dec.decompress(data))
+                    data = dec.unused_data
+                break
+        return b"".join(out)
+
+    def read_bytes(self):
+        with open(self.path, "rb") as f:
+            f.seek(self.start)
+            own = self._inflate(f.read(self.end - self.start))
+            if self.start > 0:
+                nl = own.find(b"\n")
+                if nl < 0:
+                    # our whole range is a partial line owned by the left
+                    # neighbor (mirrors TextLineDataset's crossed-end skip)
+                    return b""
+                own = own[nl + 1:]
+            if self.end < self.file_size:
+                ext = []
+                off = self.end
+                while off < self.file_size:
+                    msize = _bgzf_member_size(f, off)
+                    if msize is None:
+                        break
+                    f.seek(off)
+                    piece = self._inflate(f.read(msize))
+                    off += msize
+                    nl = piece.find(b"\n")
+                    if nl >= 0:
+                        ext.append(piece[: nl + 1])
+                        break
+                    ext.append(piece)
+                own += b"".join(ext)
+        return own
+
+    def read(self):
+        # Keys are int offsets (compressed chunk start + local decompressed
+        # position): unique-ish identifiers in the same int64 fast lane as
+        # the text/gzip taps' byte offsets — never semantic offsets.
+        data = self.read_bytes()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            nl = data.find(b"\n", pos)
+            end = n if nl < 0 else nl
+            yield self.start + pos, data[pos:end].decode("utf-8")
+            pos = end + 1
+
+    def __repr__(self):
+        return "Bgzf[path={},start={},end={}]".format(
+            self.path, self.start, self.end)
 
 
 class MemoryInput(Chunker):

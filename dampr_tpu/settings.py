@@ -122,6 +122,11 @@ mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
 #: takes precedence where it applies.
 mesh_exchange = os.environ.get("DAMPR_TPU_MESH_EXCHANGE", "auto")
 
+#: Ingest readahead window (chunks): a background thread prefetches the next
+#: chunks' bytes (file IO + gzip inflate release the GIL) while the current
+#: chunk computes.  0 disables.  See inputs.Readahead.
+readahead_chunks = int(os.environ.get("DAMPR_TPU_READAHEAD", "2"))
+
 #: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")

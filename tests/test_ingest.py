@@ -1,0 +1,233 @@
+"""Ingest planner, readahead, and splittable-gzip (BGZF) taps."""
+
+import gzip
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu import inputs as I
+
+
+def write_bgzf(path, text, block_lines=7):
+    """Minimal BGZF writer: one gzip member per `block_lines` lines, each
+    carrying the htslib BC extra subfield with its compressed size."""
+    lines = text.splitlines(keepends=True)
+    with open(path, "wb") as f:
+        for at in range(0, len(lines), block_lines):
+            payload = "".join(lines[at:at + block_lines]).encode()
+            f.write(_bgzf_member(payload))
+        f.write(_bgzf_member(b""))  # EOF marker block
+
+
+def _bgzf_member(payload):
+    comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+    cdata = comp.compress(payload) + comp.flush()
+    bsize = 12 + 6 + len(cdata) + 8  # hdr + extra + deflate + crc/isize
+    hdr = struct.pack(
+        "<2sBBIBBH2sHH", b"\x1f\x8b", 8, 4, 0, 0, 255, 6, b"BC", 2,
+        bsize - 1)
+    return hdr + cdata + struct.pack("<II", zlib.crc32(payload) & 0xFFFFFFFF,
+                                     len(payload) & 0xFFFFFFFF)
+
+
+SAMPLE = "".join("line %04d with words\n" % i for i in range(200))
+
+
+class TestPlanner:
+    def test_plan_text_ranges(self, tmp_path):
+        p = str(tmp_path / "a.txt")
+        open(p, "w").write(SAMPLE)
+        size = os.path.getsize(p)
+        specs = I.plan_chunks(p, 1000)
+        assert all(s.kind == "text" for s in specs)
+        assert specs[0].start == 0 and specs[-1].end == size
+        assert len(specs) == -(-size // 1000)
+
+    def test_sniff_by_magic_not_extension(self, tmp_path):
+        fake_gz = str(tmp_path / "fake.gz")  # plain text, lying name
+        open(fake_gz, "w").write(SAMPLE)
+        specs = I.plan_chunks(fake_gz, 1000)
+        assert all(s.kind == "text" for s in specs)
+        assert len(specs) > 1  # splittable, unlike extension-based routing
+
+        real_gz = str(tmp_path / "real.gz")
+        with gzip.open(real_gz, "wt") as f:
+            f.write(SAMPLE)
+        specs = I.plan_chunks(real_gz, 10)
+        assert [s.kind for s in specs] == ["gzip"]  # unsplittable
+
+    def test_scandir_walk_sorted_and_hides_dotfiles(self, tmp_path):
+        d = tmp_path / "tree"
+        (d / "sub").mkdir(parents=True)
+        (d / "b.txt").write_text("b\n")
+        (d / "a.txt").write_text("a\n")
+        (d / ".hidden").write_text("x\n")
+        (d / "sub" / "c.txt").write_text("c\n")
+        got = list(I.read_paths(str(d)))
+        assert got == [str(d / "a.txt"), str(d / "b.txt"),
+                       str(d / "sub" / "c.txt")]
+
+
+class TestBgzf:
+    def test_detected_and_split(self, tmp_path):
+        p = str(tmp_path / "x.bgzf.gz")
+        write_bgzf(p, SAMPLE)
+        assert I._sniff(p) == "bgzf"
+        specs = I.plan_chunks(p, 300)  # small: several member groups
+        assert all(s.kind == "bgzf" for s in specs)
+        assert len(specs) > 3
+
+    def test_chunks_cover_every_line_exactly_once(self, tmp_path):
+        p = str(tmp_path / "x.gz")
+        write_bgzf(p, SAMPLE, block_lines=3)
+        for chunk_size in (100, 250, 1000, 10 ** 6):
+            specs = I.plan_chunks(p, chunk_size)
+            text = b"".join(I._spec_dataset(s).read_bytes()
+                            for s in specs).decode()
+            assert text == SAMPLE, chunk_size
+
+    def test_read_lines_match(self, tmp_path):
+        p = str(tmp_path / "x.gz")
+        write_bgzf(p, SAMPLE, block_lines=5)
+        specs = I.plan_chunks(p, 200)
+        lines = []
+        for s in specs:
+            lines.extend(v for _k, v in I._spec_dataset(s).read())
+        assert lines == [ln for ln in SAMPLE.split("\n") if ln != ""]
+
+    def test_pipeline_matches_plain_text(self, tmp_path):
+        plain = str(tmp_path / "plain.txt")
+        open(plain, "w").write(SAMPLE)
+        bg = str(tmp_path / "blocked.gz")
+        write_bgzf(bg, SAMPLE, block_lines=4)
+        a = dict(Dampr.text(plain, 500).flat_map(str.split).count().read())
+        b = dict(Dampr.text(bg, 300).flat_map(str.split).count().read())
+        assert a == b
+
+
+class TestBgzfEdgeCases:
+    def test_trailing_plain_gzip_member_falls_back_whole(self, tmp_path):
+        # A legal gzip concatenation whose tail is NOT BGZF must not split
+        # (splitting would silently drop the tail): whole-stream fallback.
+        p = str(tmp_path / "mixed.gz")
+        with open(p, "wb") as f:
+            f.write(_bgzf_member(b"a\nb\nc\nd\n"))
+            f.write(_bgzf_member(b"e\nf\n"))
+            f.write(gzip.compress(b"g\nh\n"))
+        specs = I.plan_chunks(p, 10)
+        assert [s.kind for s in specs] == ["gzip"]
+        got = I._spec_dataset(specs[0]).read_bytes()
+        assert got == b"a\nb\nc\nd\ne\nf\ng\nh\n"  # nothing lost
+
+    def test_gzi_index_plans_without_member_walk(self, tmp_path):
+        p = str(tmp_path / "x.gz")
+        write_bgzf(p, SAMPLE, block_lines=5)
+        walk_specs = I.plan_chunks(p, 300)
+        # synthesize the .gzi from the walk's member offsets
+        offs = []
+        size = os.path.getsize(p)
+        with open(p, "rb") as f:
+            off = 0
+            while off < size:
+                ms = I._bgzf_member_size(f, off)
+                off += ms
+                if off < size:
+                    offs.append(off)
+        with open(p + ".gzi", "wb") as f:
+            f.write(len(offs).to_bytes(8, "little"))
+            for o in offs:
+                f.write(o.to_bytes(8, "little"))
+                f.write((0).to_bytes(8, "little"))  # uncompressed: unused
+        gzi_specs = I.plan_chunks(p, 300)
+        assert [(s.start, s.end) for s in gzi_specs] == [
+            (s.start, s.end) for s in walk_specs]
+        text = b"".join(I._spec_dataset(s).read_bytes()
+                        for s in gzi_specs).decode()
+        assert text == SAMPLE
+
+    def test_broken_symlink_ignored(self, tmp_path):
+        d = tmp_path / "dir"
+        d.mkdir()
+        (d / "ok.txt").write_text("fine\n")
+        os.symlink(str(tmp_path / "nonexistent"), str(d / "broken.txt"))
+        got = list(I.read_paths(str(d) + "/*.txt"))
+        assert got == [str(d / "ok.txt")]
+
+    def test_bgzf_keys_are_ints(self, tmp_path):
+        p = str(tmp_path / "x.gz")
+        write_bgzf(p, SAMPLE, block_lines=5)
+        spec = I.plan_chunks(p, 300)[1]
+        for k, _v in I._spec_dataset(spec).read():
+            assert isinstance(k, int)
+
+
+class TestReadahead:
+    def test_prefetch_matches_direct(self, tmp_path):
+        p = str(tmp_path / "a.txt")
+        open(p, "w").write(SAMPLE)
+        old = settings.readahead_chunks
+        settings.readahead_chunks = 2
+        try:
+            chunks = list(I.PathInput(p, chunk_size=400).chunks())
+            assert any(isinstance(c, I.PrefetchedChunk) for c in chunks)
+            direct = list(I.TextInput(p, chunk_size=400).chunks())
+            for c, d in zip(chunks, direct):
+                assert c.read_bytes() == d.read_bytes()
+        finally:
+            settings.readahead_chunks = old
+
+    def test_out_of_order_take(self):
+        loads = [lambda i=i: b"chunk%d" % i for i in range(6)]
+        ra = I.Readahead(loads, depth=2)
+        assert ra.take(3) == b"chunk3"
+        assert ra.take(0) == b"chunk0"
+        assert ra.take(5) == b"chunk5"
+        assert ra.take(1) == b"chunk1"
+
+    def test_inflight_load_is_waited_not_duplicated(self):
+        import threading
+        import time
+
+        calls = []
+        gate = threading.Event()
+
+        def slow0():
+            calls.append(0)
+            gate.wait(5)
+            return b"zero"
+
+        def fast1():
+            calls.append(1)
+            return b"one"
+
+        ra = I.Readahead([slow0, fast1], depth=1)
+        t = threading.Thread(target=lambda: calls.append(("got", ra.take(0))))
+        t.start()
+        time.sleep(0.2)  # let the prefetch thread start loading 0
+        gate.set()
+        t.join(5)
+        assert ("got", b"zero") in calls
+        assert calls.count(0) == 1  # never loaded twice
+
+    def test_loader_error_propagates(self):
+        def boom():
+            raise IOError("disk gone")
+
+        ra = I.Readahead([boom], depth=1)
+        with pytest.raises(IOError):
+            ra.take(0)
+
+    def test_zero_depth_disables(self, tmp_path):
+        p = str(tmp_path / "a.txt")
+        open(p, "w").write(SAMPLE)
+        old = settings.readahead_chunks
+        settings.readahead_chunks = 0
+        try:
+            chunks = list(I.PathInput(p, chunk_size=400).chunks())
+            assert not any(isinstance(c, I.PrefetchedChunk) for c in chunks)
+        finally:
+            settings.readahead_chunks = old
