@@ -151,7 +151,7 @@ def _pack_by_dest(inv, h1, h2, v, n_dev, capacity):
 
 @functools.lru_cache(maxsize=None)
 def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
-                        axis, nonneg_sum=False):
+                        axis, nonneg_sum=False, gather=False):
     """Compile the full shard_map keyed-fold program for one shape bucket.
     ``mesh`` participates in the cache key so re-meshing recompiles."""
     import jax
@@ -188,15 +188,29 @@ def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
 
         total_dropped = lax.psum(dropped, axis)
         out_valid = jnp.where(inv2 == 0, jnp.uint32(1), jnp.uint32(0))
+        if gather:
+            # Multi-process runs cannot fetch axis-sharded outputs at the
+            # host boundary (shards live on other hosts' devices), so
+            # replicate results with one all_gather ring over ICI/DCN.
+            fh1 = lax.all_gather(fh1, axis, tiled=True)
+            fh2 = lax.all_gather(fh2, axis, tiled=True)
+            fv = lax.all_gather(fv, axis, tiled=True)
+            out_valid = lax.all_gather(out_valid, axis, tiled=True)
         return fh1, fh2, fv, out_valid, total_dropped
 
     def program(h1, h2, v, valid):
+        out_spec = P() if gather else P(axis)
+        kwargs = {}
+        if gather:
+            # all_gather output IS replicated; the varying-axes inference
+            # can't prove it, so disable the check for this variant.
+            kwargs["check_vma"] = False
         return jax.shard_map(
             per_device,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis)),
-            out_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-        )(h1, h2, v, valid)
+            out_specs=(out_spec, out_spec, out_spec, out_spec, P()),
+            **kwargs)(h1, h2, v, valid)
 
     return jax.jit(program)
 
@@ -304,9 +318,11 @@ def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):
                 nonneg = True  # abs-sum check ran in _lane_safe_values
         elif v.dtype == np.int64:
             nonneg = len(v) * int(v.max()) <= _I64_MAX
+    gather = jax.process_count() > 1
     while True:
         prog = _build_fold_program(mesh, n_dev, n_local, capacity, kind,
-                                   np.dtype(v.dtype).name, axis, nonneg)
+                                   np.dtype(v.dtype).name, axis, nonneg,
+                                   gather)
         fh1, fh2, fv, ok, dropped = prog(ph1, ph2, pv, pvalid)
         if int(dropped) == 0:
             mask = np.asarray(ok) == 1

@@ -1,0 +1,88 @@
+"""Real multi-process distributed backend: 2 OS processes join via
+jax.distributed.initialize (localhost coordinator), form one 8-device
+global mesh (4 virtual CPU devices per process), and drive the collective
+shuffle across the process boundary — psum and the keyed fold both verified
+exact on every process (VERDICT r2 task 7: init_distributed had zero
+coverage)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, "@ROOT@")
+    from dampr_tpu.parallel.mesh import init_distributed, data_mesh
+    init_distributed(coordinator_address="localhost:%s" % port,
+                     num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+    import numpy as np
+    from dampr_tpu import settings
+    settings.device_min_batch = 1
+    from dampr_tpu.ops import hashing
+    from dampr_tpu.parallel import mesh_global_sum, mesh_keyed_fold
+    mesh = data_mesh()
+    rng = np.random.RandomState(7)
+    keys = rng.randint(0, 50, size=4096)
+    vals = rng.randint(0, 9, size=4096).astype(np.int64)
+    h1, h2 = hashing.hash_keys(keys)
+    total = mesh_global_sum(mesh, vals)
+    assert total == int(vals.sum()), (total, int(vals.sum()))
+    fh1, fh2, fv = mesh_keyed_fold(mesh, h1, h2, vals, "sum")
+    import collections
+    want = collections.Counter()
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] += v
+    kh1, kh2 = hashing.hash_keys(np.arange(50))
+    lut = {(int(a), int(b)): i
+           for i, (a, b) in enumerate(zip(kh1, kh2))}
+    got = {lut[(int(a), int(b))]: int(v)
+           for a, b, v in zip(fh1, fh2, fv)}
+    assert got == dict(want), "keyed fold diverged on process %d" % pid
+    print("PROC_%d_OK" % pid, flush=True)
+""").replace("@ROOT@", ROOT)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestTwoProcessBackend:
+    def test_keyed_fold_and_psum_across_processes(self, tmp_path):
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # worker sets its own device count
+        script = str(tmp_path / "worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(i), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            for i in range(2)]
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append((p.returncode, out, err))
+        for i, (rc, out, err) in enumerate(outs):
+            assert rc == 0, (i, out, err[-2000:])
+            assert "PROC_%d_OK" % i in out, (i, out, err[-2000:])
