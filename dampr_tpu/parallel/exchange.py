@@ -113,6 +113,11 @@ def _unpack_group(blob):
             for seq, pid, (k, v, h1, h2) in pickle.loads(blob)]
 
 
+#: Process-level cumulative stats (observability; tests assert engagement).
+total_exchanges = 0
+total_bytes = 0
+
+
 def mesh_shuffle_blocks(mesh, routed):
     """Exchange one window of routed blocks across the mesh.
 
@@ -125,6 +130,7 @@ def mesh_shuffle_blocks(mesh, routed):
     sorted by seq; bytes_moved counts payload bytes that crossed the
     collective.
     """
+    global total_exchanges, total_bytes
     D = mesh_size(mesh)
     groups = {}
     for seq, src, pid, blk in routed:
@@ -132,6 +138,8 @@ def mesh_shuffle_blocks(mesh, routed):
     blobs = {sd: _pack_group(items) for sd, items in groups.items()}
     moved = sum(len(b) for b in blobs.values())
     recv = mesh_blob_exchange(mesh, blobs)
+    total_exchanges += 1
+    total_bytes += moved
     out = []
     for (s, d), blob in recv.items():
         for seq, pid, blk in _unpack_group(blob):

@@ -64,6 +64,42 @@ class _SinkOutput(object):
         return [SinkDataset(p) for p in self.paths]
 
 
+def _exchange_mesh_gate(budget):
+    """Shared engage/window policy for every mesh byte-exchange user.
+    Returns (mesh, D, window_bytes) or None when the path is off or only
+    one device is visible.  The window bound keeps the worst-case send
+    buffer (D*D rows of one blob's pow2 bucket) a fraction of the budget."""
+    mode = str(settings.mesh_exchange).lower()
+    if mode in ("off", "0", "false") or not settings.use_device:
+        return None
+    import jax
+
+    if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+        return None
+    from .parallel.mesh import data_mesh, mesh_size
+
+    mesh = data_mesh()
+    D = mesh_size(mesh)
+    window = max(1 << 18, budget // (8 * D * D))
+    return mesh, D, window
+
+
+class _RawRef(object):
+    """Minimal in-memory stand-in for BlockRef when an OutputDataset has no
+    store (direct construction in tests/tools)."""
+
+    __slots__ = ("_block",)
+
+    def __init__(self, block):
+        self._block = block
+
+    def get(self):
+        return self._block
+
+    def delete(self):
+        self._block = None
+
+
 class OutputDataset(Dataset):
     """Final-output view over a PartitionSet: reads records in ascending key
     order (the reference heap-merges sorted partition runs —
@@ -75,6 +111,8 @@ class OutputDataset(Dataset):
     def __init__(self, pset, store=None):
         self.pset = pset
         self.store = store
+        self._range_cache = None  # mesh range-sort bucket runs, reused
+        #                           across reads, released in delete()
 
     def _partition_stream(self, pid):
         from .dataset import OrderKey
@@ -125,7 +163,9 @@ class OutputDataset(Dataset):
         blk = self._sorted_concat()
         if blk is not None:
             return blk.iter_pairs()
-        blocks = self._vector_merge_blocks(pids)
+        blocks = self._mesh_range_sorted(pids)
+        if blocks is None:
+            blocks = self._vector_merge_blocks(pids)
         if blocks is not None:
             return itertools.chain.from_iterable(
                 b.iter_pairs() for b in blocks)
@@ -143,6 +183,97 @@ class OutputDataset(Dataset):
             return None
         order = np.argsort(blk.keys, kind="stable")  # TypeError -> caller
         return blk.take(order)
+
+    def _mesh_range_sorted(self, pids, chunk=1 << 16):
+        """sort_by's redistribution on the mesh: numeric-keyed partitions
+        re-partition by key *range* across the devices — sampled quantile
+        bounds route every record through the collective byte exchange to
+        device ``bucket`` (bucket b ≡ pid b, so ``pid % D`` lands it
+        there) — and global order becomes bucket order, each bucket merged
+        independently.  Returns a sorted-block generator, or None when the
+        mesh path is off, single-device, or keys are non-numeric."""
+        if self._range_cache is None:
+            budget = (self.store.budget if self.store is not None
+                      else settings.max_memory_per_stage)
+            gate = _exchange_mesh_gate(budget)
+            if gate is None:
+                return None
+            mesh, D, window = gate
+            refs = [r for pid in pids for r in self.pset.refs(pid)]
+            if not refs:
+                return iter(())
+            if any(getattr(r, "key_dtype", np.dtype(object)) == object
+                   for r in refs):
+                return None
+            from .parallel import exchange as px
+
+            # Range bounds from a strided sample (hash-partitioned runs are
+            # key-random, so per-ref strides sample uniformly).
+            per = max(16, 65536 // len(refs))
+            samples = []
+            for r in refs:
+                for w in r.iter_windows():
+                    if len(w):
+                        stride = max(1, len(w) // per)
+                        samples.append(np.asarray(w.keys[::stride]))
+                    break
+            if not samples:
+                return iter(())
+            allk = np.concatenate(samples)
+            bounds = np.quantile(allk, np.linspace(0, 1, D + 1)[1:-1])
+
+            bucket_refs = [[] for _ in range(D)]
+            state = {"batch": [], "bytes": 0, "seq": 0}
+
+            def flush():
+                if not state["batch"]:
+                    return
+                received, _moved = px.mesh_shuffle_blocks(
+                    mesh, state["batch"])
+                for b, blk in received:
+                    # store each bucket piece key-sorted: a mergeable run
+                    order = np.argsort(blk.keys, kind="stable")
+                    bucket_refs[b].append(
+                        self.store.register(blk.take(order))
+                        if self.store is not None
+                        else _RawRef(blk.take(order)))
+                state["batch"], state["bytes"] = [], 0
+
+            for r in refs:
+                for w in r.iter_windows():
+                    if not len(w):
+                        continue
+                    keys = np.asarray(w.keys)
+                    bidx = np.searchsorted(bounds, keys)
+                    order = np.argsort(bidx, kind="stable")
+                    sb = bidx[order]
+                    edges = np.flatnonzero(np.diff(sb)) + 1
+                    at = 0
+                    for end in list(edges) + [len(sb)]:
+                        if end > at:
+                            b = int(sb[at])
+                            state["batch"].append(
+                                (state["seq"], state["seq"] % D, b,
+                                 w.take(order[at:end])))
+                            state["seq"] += 1
+                            state["bytes"] += w.nbytes() * (end - at) // max(
+                                1, len(w))
+                        at = end
+                    if state["bytes"] >= window:
+                        flush()
+            flush()
+            # The bucket runs ARE the sorted materialization: cache them so
+            # repeated reads reuse one exchange, and release them (only) in
+            # delete() — abandoned read iterators cannot leak refs.
+            self._range_cache = bucket_refs
+
+        def gen():
+            for brefs in self._range_cache:
+                parts = [ref.get() for ref in brefs]
+                for blk in self._merge_sorted_parts(parts, chunk):
+                    yield blk
+
+        return gen()
 
     def _vector_merge_blocks(self, pids, chunk=1 << 16):
         """K-way merge of key-sorted numeric-keyed partitions, emitted as
@@ -162,6 +293,14 @@ class OutputDataset(Dataset):
                 parts.append(blk)
         if not parts:
             return iter(())
+
+        return self._merge_sorted_parts(parts, chunk)
+
+    @staticmethod
+    def _merge_sorted_parts(parts, chunk=1 << 16):
+        """Vectorized k-way merge over key-sorted blocks (see
+        _vector_merge_blocks for the chunking/tie rules)."""
+        parts = [p for p in parts if len(p)]
 
         def slice_of(blk, a, b):
             return Block(
@@ -233,7 +372,9 @@ class OutputDataset(Dataset):
                 yield blk
             return
         pids = sorted(self.pset.parts)
-        blocks = self._vector_merge_blocks(pids)
+        blocks = self._mesh_range_sorted(pids)
+        if blocks is None:
+            blocks = self._vector_merge_blocks(pids)
         if blocks is not None:
             for b in blocks:
                 yield b
@@ -248,6 +389,14 @@ class OutputDataset(Dataset):
             yield out
 
     def delete(self):
+        if self._range_cache is not None:
+            for brefs in self._range_cache:
+                for ref in brefs:
+                    if self.store is not None:
+                        self.store.drop_ref(ref)
+                    else:
+                        ref.delete()
+            self._range_cache = None
         self.pset.delete(self.store)
 
 
@@ -644,22 +793,11 @@ class MTRunner(object):
         inputs route identically.  Returns the exchanged PartitionSets (new
         refs registered against the store), or None when the mesh path is
         disabled or only one device is visible."""
-        mode = str(settings.mesh_exchange).lower()
-        if mode in ("off", "0", "false") or not settings.use_device:
+        gate = _exchange_mesh_gate(self.store.budget)
+        if gate is None:
             return None
-        import jax
-
-        if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
-            return None
+        mesh, D, window = gate
         from .parallel import exchange as px
-        from .parallel.mesh import data_mesh, mesh_size
-
-        mesh = data_mesh()
-        D = mesh_size(mesh)
-        # Worst-case skew sends a whole window to one (src, dst) pair, and
-        # the send buffer is D*D rows of that blob's pow2 bucket — bound the
-        # window so the buffer stays a fraction of the budget.
-        window = max(1 << 18, self.store.budget // (8 * D * D))
 
         out_entries = []
         ran_exchange = False

@@ -154,6 +154,46 @@ class TestEngineExchange:
         assert list(ds.read()) == []
         assert runner.mesh_exchanges == 0  # nothing actually crossed
 
+    def test_sort_by_redistributes_over_mesh(self):
+        # Numeric over-budget sort: the sorted read re-partitions by key
+        # range through the collective exchange; order must be exact.
+        import random
+
+        from dampr_tpu.parallel import exchange as px
+        rng = random.Random(5)
+        data = [rng.randrange(-10 ** 9, 10 ** 9) for _ in range(30000)]
+        pipe = Dampr.memory(data, partitions=8).sort_by(lambda x: x)
+        runner = MTRunner("mesh-range-sort", pipe.pmer.graph,
+                          memory_budget=1 << 16)  # forces past sorted-concat
+        out = runner.run([pipe.source])[0]
+        before = px.total_exchanges
+        got = [v for _k, v in out.read()]
+        assert got == sorted(data)
+        assert px.total_exchanges > before, "range sort never hit the mesh"
+        # repeated reads reuse the cached bucket runs: no second exchange
+        after_first = px.total_exchanges
+        got2 = [v for _k, v in out.read()]
+        assert got2 == got
+        assert px.total_exchanges == after_first
+        # partial consumption must not leak; delete releases the cache
+        next(iter(out.read()))
+        out.delete()
+        assert out._range_cache is None
+
+    def test_sort_by_mesh_matches_host_path(self):
+        data = [((i * 7919) % 10007) for i in range(20000)]
+
+        def run_it():
+            pipe = Dampr.memory(data, partitions=8).sort_by(lambda x: x)
+            runner = MTRunner("range-sort-cmp", pipe.pmer.graph,
+                              memory_budget=1 << 16)
+            return [v for _k, v in runner.run([pipe.source])[0].read()]
+
+        mesh_got = run_it()
+        settings.mesh_exchange = "off"
+        host_got = run_it()
+        assert mesh_got == host_got == sorted(data)
+
     def test_exchange_off_never_engages(self):
         settings.mesh_exchange = "off"
         pipe = (Dampr.memory(list(range(100)), partitions=4)
