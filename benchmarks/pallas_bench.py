@@ -1,0 +1,166 @@
+"""Real-TPU benchmark for both Pallas kernels vs their XLA counterparts,
+settling the perf claims with measurements (VERDICT r2 task 5):
+
+1. ``ops.pallas_fnv.fnv_pallas`` (VMEM-resident dual-lane FNV byte scan)
+   vs the portable ``ops.hashing._fnv_jit`` fori-loop kernel, on a padded
+   token matrix generated on-device.
+2. ``ops.pallas_segfold.segfold_sorted`` (fused post-sort segmented fold)
+   vs the XLA scan chain in ``parallel.shuffle._local_fold`` — both run on
+   the same pre-sorted data; the comparison isolates the post-sort chain.
+
+Timing is amortized inside one jitted fori_loop per measurement (the
+remote-tunnel dispatch here costs ~65 ms per call), with a checksum
+accumulated so nothing is dead code.  Each kernel's outputs are first
+verified against the XLA/host reference for the same inputs.
+
+    python benchmarks/pallas_bench.py [--iters 20]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def bench_fnv(iters):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dampr_tpu.ops.hashing import _fnv_jit
+    from dampr_tpu.ops.pallas_fnv import fnv_pallas
+
+    n, L = 1 << 17, 16  # 128k tokens, 16-byte pad bucket (typical words)
+
+    def gen(seed):
+        key = jax.random.PRNGKey(seed)
+        mat = jax.random.randint(key, (n, L), 97, 123, dtype=jnp.int32
+                                 ).astype(jnp.uint8)
+        lens = jax.random.randint(jax.random.fold_in(key, 1), (n,), 1, L,
+                                  dtype=jnp.int32)
+        return mat, lens
+
+    # verify parity once
+    mat, lens = gen(0)
+    a1, a2 = _fnv_jit()(mat, lens)
+    b1, b2 = fnv_pallas(np.asarray(mat), np.asarray(lens))
+    assert (np.asarray(a1) == np.asarray(b1)).all()
+    assert (np.asarray(a2) == np.asarray(b2)).all()
+
+    results = {}
+    for name, fn in (("xla", lambda m, l: _fnv_jit()(m, l)),
+                     ("pallas", fnv_pallas)):
+        def loop(seed0, fn=fn):
+            def body(i, acc):
+                m, l = gen(seed0 + i)
+                h1, h2 = fn(m, l)
+                return acc ^ h1[0] ^ h2[-1]
+
+            return lax.fori_loop(0, iters, body, jnp.uint32(0))
+
+        jl = jax.jit(loop)
+        jax.device_get(jl(0))
+        t0 = time.time()
+        jax.device_get(jl(100))
+        results[name] = (time.time() - t0) / iters
+    return {
+        "tokens": n,
+        "xla_Mtok_s": round(n / results["xla"] / 1e6, 1),
+        "pallas_Mtok_s": round(n / results["pallas"] / 1e6, 1),
+        "pallas_speedup": round(results["xla"] / results["pallas"], 2),
+    }
+
+
+def bench_segfold(iters):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dampr_tpu.ops import pallas_segfold as SF
+    from dampr_tpu.parallel.shuffle import _local_fold
+
+    n = 1 << 22
+
+    def gen_sorted(seed):
+        key = jax.random.PRNGKey(seed)
+        ids = jax.random.randint(key, (n,), 0, 1 << 16, dtype=jnp.int32)
+        h1 = jnp.sort(ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+        h2 = h1 ^ jnp.uint32(0x85EBCA6B)
+        v = jnp.ones((n,), jnp.int32)
+        inv = jnp.zeros((n,), jnp.uint32)
+        return h1, h2, v, inv
+
+    # verify parity once (totals per (h1,h2) against the XLA scan chain)
+    h1, h2, v, inv = gen_sorted(0)
+    oinv, oh1, oh2, ov = _local_fold(inv, h1, h2, v, "sum", nonneg_sum=True)
+    tot, live = SF.segfold_sorted(np.asarray(h1), np.asarray(h2),
+                                  np.asarray(v), np.asarray(inv))
+    want = {}
+    m = np.asarray(oinv) == 0
+    for a, b, t in zip(np.asarray(oh1)[m], np.asarray(oh2)[m],
+                       np.asarray(ov)[m]):
+        want[(int(a), int(b))] = int(t)
+    got = {}
+    lm = np.asarray(live) == 1
+    ah1, ah2, at = np.asarray(h1)[lm], np.asarray(h2)[lm], np.asarray(tot)[lm]
+    for a, b, t in zip(ah1, ah2, at):
+        got[(int(a), int(b))] = int(t)
+    assert got == want, "pallas segfold diverged from the XLA scan chain"
+
+    from dampr_tpu.parallel.shuffle import _scan_fold_sorted
+
+    def xla_chain(h1, h2, v, inv):
+        # post-sort chain only — inputs are pre-sorted, same as pallas
+        return _scan_fold_sorted(inv, h1, h2, v)[3][0]
+
+    te = SF._tile_elems()
+    n_tiles = n // te
+
+    def pallas_chain(h1, h2, v, inv):
+        shape = (n_tiles * SF._ROWS, SF._LANES)
+        tot, live = SF._segfold_call(n_tiles, False)(
+            h1.reshape(shape), h2.reshape(shape), v.reshape(shape),
+            inv.reshape(shape))
+        return tot[0, 0]
+
+    results = {}
+    for name, fn in (("xla_scan", xla_chain), ("pallas", pallas_chain)):
+        def loop(seed0, fn=fn):
+            def body(i, acc):
+                h1, h2, v, inv = gen_sorted(seed0 + i)
+                return acc + fn(h1, h2, v, inv).astype(jnp.int32)
+
+            return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+        jl = jax.jit(loop)
+        jax.device_get(jl(0))
+        t0 = time.time()
+        jax.device_get(jl(100))
+        results[name] = (time.time() - t0) / iters
+    return {
+        "records": n,
+        "xla_scan_Mrec_s": round(n / results["xla_scan"] / 1e6, 1),
+        "pallas_Mrec_s": round(n / results["pallas"] / 1e6, 1),
+        "pallas_speedup": round(results["xla_scan"] / results["pallas"], 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--only", choices=["fnv", "segfold"])
+    args = ap.parse_args()
+
+    import jax
+
+    out = {"metric": "pallas_vs_xla", "backend": jax.default_backend()}
+    if args.only in (None, "fnv"):
+        out["fnv"] = bench_fnv(args.iters)
+    if args.only in (None, "segfold"):
+        out["segfold"] = bench_segfold(args.iters)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,16 +78,7 @@ def _local_fold(inv, h1, h2, v, kind, nonneg_sum=False):
     starts = _segments(inv, h1, h2)
 
     if nonneg_sum and kind == "sum":
-        ends = jnp.concatenate(
-            [starts[1:], jnp.ones((1,), dtype=starts.dtype)])
-        csum = jnp.cumsum(v)
-        ex = csum - v  # exclusive prefix, nonneg + monotone by assumption
-        start_ex = lax.cummax(jnp.where(starts, ex, -1))
-        tot = jnp.where(ends, csum - start_ex, 0).astype(v.dtype)
-        # The end entry of a segment carries the segment's own (h1, h2);
-        # invalid records sort last and form all-invalid segments.
-        live = ends & (inv == 0)
-        return (jnp.where(live, jnp.uint32(0), jnp.uint32(1)), h1, h2, tot)
+        return _scan_fold_sorted(inv, h1, h2, v, starts)
 
     seg_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
     if kind == "sum":
@@ -112,6 +103,29 @@ def _local_fold(inv, h1, h2, v, kind, nonneg_sum=False):
     live = (live == 1) & in_range
     return (jnp.where(live, jnp.uint32(0), jnp.uint32(1)),
             seg_h1, seg_h2, folded)
+
+
+def _scan_fold_sorted(inv, h1, h2, v, starts=None):
+    """The post-sort scan chain of the nonneg-sum lowering (see
+    _local_fold): segment totals land at segment-end positions via cumsum +
+    a cummax-carried start offset, no scatters.  Exposed separately so
+    benchmarks/pallas_bench.py can compare it against the fused Pallas
+    kernel on identical pre-sorted inputs."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if starts is None:
+        starts = _segments(inv, h1, h2)
+    ends = jnp.concatenate(
+        [starts[1:], jnp.ones((1,), dtype=starts.dtype)])
+    csum = jnp.cumsum(v)
+    ex = csum - v  # exclusive prefix, nonneg + monotone by assumption
+    start_ex = lax.cummax(jnp.where(starts, ex, -1))
+    tot = jnp.where(ends, csum - start_ex, 0).astype(v.dtype)
+    # The end entry of a segment carries the segment's own (h1, h2);
+    # invalid records sort last and form all-invalid segments.
+    live = ends & (inv == 0)
+    return (jnp.where(live, jnp.uint32(0), jnp.uint32(1)), h1, h2, tot)
 
 
 def _pack_by_dest(inv, h1, h2, v, n_dev, capacity):

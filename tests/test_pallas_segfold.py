@@ -1,0 +1,109 @@
+"""Fused Pallas segmented fold vs the exact host oracle (interpret mode on
+CPU; the Mosaic lowering and real-chip numbers are benchmarks territory —
+benchmarks/pallas_bench.py)."""
+
+import numpy as np
+import pytest
+
+from dampr_tpu.ops import pallas_segfold as SF
+
+
+def _sorted_case(rng, n_keys, n, max_v=9, n_invalid=0):
+    """Random sorted-by-(inv,h1,h2) arrays + oracle outputs."""
+    kh1 = rng.randint(0, 1 << 32, size=n_keys, dtype=np.uint64).astype(
+        np.uint32)
+    kh2 = rng.randint(0, 1 << 32, size=n_keys, dtype=np.uint64).astype(
+        np.uint32)
+    ids = np.sort(rng.randint(0, n_keys, size=n - n_invalid))
+    h1 = kh1[ids]
+    h2 = kh2[ids]
+    inv = np.zeros(n, dtype=np.uint32)
+    if n_invalid:
+        h1 = np.concatenate([h1, np.zeros(n_invalid, np.uint32)])
+        h2 = np.concatenate([h2, np.zeros(n_invalid, np.uint32)])
+        inv[n - n_invalid:] = 1
+    v = rng.randint(0, max_v + 1, size=n).astype(np.int32)
+    # sort by (inv, h1, h2) like the engine does
+    order = np.lexsort((h2, h1, inv))
+    return h1[order], h2[order], v[order], inv[order]
+
+
+def _pad(h1, h2, v, inv):
+    te = SF._tile_elems()
+    n = len(h1)
+    npad = -(-n // te) * te
+    if npad != n:
+        pad = npad - n
+        h1 = np.concatenate([h1, np.zeros(pad, h1.dtype)])
+        h2 = np.concatenate([h2, np.zeros(pad, h2.dtype)])
+        v = np.concatenate([v, np.zeros(pad, v.dtype)])
+        inv = np.concatenate([inv, np.ones(pad, inv.dtype)])
+    return h1, h2, v, inv
+
+
+def _check(h1, h2, v, inv):
+    tot, live = SF.segfold_sorted(h1, h2, v, inv, interpret=True)
+    rtot, rlive = SF.segfold_reference(h1, h2, v, inv)
+    np.testing.assert_array_equal(np.asarray(live), rlive)
+    lt = np.asarray(tot).astype(np.int64) * (np.asarray(live) == 1)
+    rt = rtot * (rlive == 1)
+    np.testing.assert_array_equal(lt, rt)
+
+
+class TestSegfoldInterpret:
+    def test_single_tile_exact(self):
+        rng = np.random.RandomState(0)
+        _check(*_pad(*_sorted_case(rng, 50, SF._tile_elems())))
+
+    def test_multi_tile_exact_with_carry(self):
+        rng = np.random.RandomState(1)
+        _check(*_pad(*_sorted_case(rng, 300, 3 * SF._tile_elems())))
+
+    def test_segment_spanning_tiles(self):
+        te = SF._tile_elems()
+        n = 2 * te
+        h1 = np.zeros(n, dtype=np.uint32)  # one giant segment
+        h2 = np.zeros(n, dtype=np.uint32)
+        v = np.ones(n, dtype=np.int32)
+        inv = np.zeros(n, dtype=np.uint32)
+        tot, live = SF.segfold_sorted(h1, h2, v, inv, interpret=True)
+        assert int(np.asarray(live).sum()) == 1
+        assert int(np.asarray(tot)[np.asarray(live) == 1][0]) == n
+
+    def test_invalid_tail_excluded(self):
+        rng = np.random.RandomState(2)
+        case = _sorted_case(rng, 40, SF._tile_elems(), n_invalid=500)
+        _check(*_pad(*case))
+
+    def test_every_element_distinct(self):
+        te = SF._tile_elems()
+        h1 = np.arange(te, dtype=np.uint32)
+        h2 = np.arange(te, dtype=np.uint32)
+        v = np.full(te, 3, dtype=np.int32)
+        inv = np.zeros(te, dtype=np.uint32)
+        tot, live = SF.segfold_sorted(h1, h2, v, inv, interpret=True)
+        assert (np.asarray(live) == 1).all()
+        assert (np.asarray(tot) == 3).all()
+
+    def test_matches_local_fold_scan_outputs(self):
+        # Oracle parity with the XLA scan lowering in _local_fold.
+        import jax.numpy as jnp
+
+        from dampr_tpu.parallel.shuffle import _local_fold
+
+        rng = np.random.RandomState(3)
+        h1, h2, v, inv = _pad(*_sorted_case(rng, 100, SF._tile_elems()))
+        oinv, oh1, oh2, ov = _local_fold(
+            jnp.asarray(inv), jnp.asarray(h1), jnp.asarray(h2),
+            jnp.asarray(v), "sum", nonneg_sum=True)
+        tot, live = SF.segfold_sorted(h1, h2, v, inv, interpret=True)
+        want = {}
+        m = np.asarray(oinv) == 0
+        for a, b, t in zip(np.asarray(oh1)[m], np.asarray(oh2)[m],
+                           np.asarray(ov)[m]):
+            want[(int(a), int(b))] = int(t)
+        got = {}
+        lm = np.asarray(live) == 1
+        for a, b, t in zip(h1[lm], h2[lm], np.asarray(tot)[lm]):
+            got[(int(a), int(b))] = int(t)
+        assert got == want
