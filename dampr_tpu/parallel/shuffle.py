@@ -281,7 +281,8 @@ def _lane_safe_values(v, kind):
         "unsupported value dtype {} for mesh folds".format(v.dtype))
 
 
-def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):
+def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None,
+                    raw=False):
     """Distributed keyed fold over a device mesh.
 
     ``h1``/``h2``: uint32 hash lanes, ``v``: numeric values (int32/int64/
@@ -289,12 +290,23 @@ def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):
     Returns ``(h1, h2, v)`` numpy arrays with one entry per distinct (h1, h2)
     pair, in unspecified order.  Retries with doubled capacity on overflow, so
     the result is complete regardless of key skew.
+
+    ``raw=True`` keeps the result DEVICE-RESIDENT: returns the padded
+    ``(h1, h2, v, ok)`` jax arrays (ok == 1 marks live entries) without the
+    host fetch/compact, so callers accumulating partials across windows
+    (runner._mesh_reduce) never round-trip intermediates through the host —
+    they re-fold partials with :func:`mesh_keyed_refold` and fetch once.
     """
     import jax
 
     n_dev = mesh_size(mesh)
     total = len(h1)
     if total == 0:
+        if raw:
+            import jax.numpy as jnp
+
+            z = jnp.zeros(0, jnp.uint32)
+            return z, z, jnp.asarray(np.asarray(v)[:0]), z
         return (np.empty(0, np.uint32), np.empty(0, np.uint32),
                 np.asarray(v)[:0])
 
@@ -311,8 +323,6 @@ def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):
     pvalid[:total] = 1
 
     factor = capacity_factor or settings.shuffle_capacity_factor
-    capacity = max(8, int(-(-n_local // n_dev) * factor))
-    axis = settings.mesh_axis
     # Integer nonneg sums (count/len/doc-freq — the hot aggregations) take
     # the scan fold lowering (padding rows are zero, so they cannot break
     # the nonneg invariant).  The lowering needs (a) a signed dtype — its -1
@@ -332,17 +342,67 @@ def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None):
                 nonneg = True  # abs-sum check ran in _lane_safe_values
         elif v.dtype == np.int64:
             nonneg = len(v) * int(v.max()) <= _I64_MAX
+    fh1, fh2, fv, ok = _run_fold_padded(
+        mesh, ph1, ph2, pv, pvalid, n_dev, n_local, kind, nonneg, factor)
+    if raw:
+        return fh1, fh2, fv, ok
+    mask = np.asarray(ok) == 1
+    return (np.asarray(fh1)[mask], np.asarray(fh2)[mask],
+            np.asarray(fv)[mask])
+
+
+def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
+                     factor):
+    """Shared capacity-retry loop over already-padded (host or device)
+    arrays: compile the program for the current capacity bucket, run,
+    double on overflow."""
+    import jax
+
+    capacity = max(8, int(-(-n_local // n_dev) * factor))
+    axis = settings.mesh_axis
     gather = jax.process_count() > 1
     while True:
         prog = _build_fold_program(mesh, n_dev, n_local, capacity, kind,
                                    np.dtype(v.dtype).name, axis, nonneg,
                                    gather)
-        fh1, fh2, fv, ok, dropped = prog(ph1, ph2, pv, pvalid)
+        fh1, fh2, fv, ok, dropped = prog(h1, h2, v, valid)
         if int(dropped) == 0:
-            mask = np.asarray(ok) == 1
-            return (np.asarray(fh1)[mask], np.asarray(fh2)[mask],
-                    np.asarray(fv)[mask])
+            return fh1, fh2, fv, ok
         capacity *= 2
+
+
+def mesh_keyed_refold(mesh, parts, kind, nonneg=False, capacity_factor=None):
+    """Re-fold device-resident partials from ``mesh_keyed_fold(raw=True)``.
+
+    ``parts``: list of (h1, h2, v, ok) jax arrays.  Everything — concat,
+    padding, the collective fold — stays on device; only the overflow
+    scalar is fetched per retry.  Lane safety is the CALLER's contract: it
+    must bound the elementwise abs-sum across every window it folded (the
+    engine tracks the running bound host-side before uploading windows),
+    because partial magnitudes are bounded by element magnitudes.  All
+    parts must share one value dtype (the engine guards this)."""
+    import jax
+    import jax.numpy as jnp
+
+    h1 = jnp.concatenate([p[0] for p in parts])
+    h2 = jnp.concatenate([p[1] for p in parts])
+    v = jnp.concatenate([p[2] for p in parts])
+    valid = jnp.concatenate([p[3] for p in parts])
+
+    n_dev = mesh_size(mesh)
+    total = h1.shape[0]
+    n_local = _pad_pow2(-(-total // n_dev))
+    padded = n_local * n_dev
+    if padded != total:
+        pad = padded - total
+        h1 = jnp.pad(h1, (0, pad))
+        h2 = jnp.pad(h2, (0, pad))
+        v = jnp.pad(v, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+
+    factor = capacity_factor or settings.shuffle_capacity_factor
+    return _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind,
+                            nonneg, factor)
 
 
 def mesh_global_sum(mesh, v):

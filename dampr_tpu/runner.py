@@ -637,6 +637,7 @@ class MTRunner(object):
         from .blocks import _concat_cols
         from .ops.hashing import combine64
         from .parallel import mesh_keyed_fold
+        from .parallel.shuffle import mesh_keyed_refold
         from .parallel.mesh import data_mesh
 
         mesh = data_mesh()
@@ -701,14 +702,19 @@ class MTRunner(object):
             if len(kt["u"]) * 80 > acc_budget:
                 raise _HostPath  # extreme cardinality: stream on host
 
+        # Device-resident accumulation state: partials are the raw padded
+        # (h1, h2, v, ok) jax arrays from each window's collective fold —
+        # they never round-trip through the host; re-folds concatenate and
+        # re-run the program in HBM, and only the final result is fetched.
+        # Lane safety across windows is tracked host-side (where the window
+        # data still is): the running elementwise abs-sum bounds every
+        # partial magnitude, and all windows must share one lane dtype.
+        acc = {"abs": 0, "dtype": None, "nonneg": True,
+               "lane_max": 2 ** 63 - 1}
+
         def compact():
-            h1 = np.concatenate([p[0] for p in partials])
-            h2 = np.concatenate([p[1] for p in partials])
-            v = np.concatenate([p[2] for p in partials])
-            try:
-                f = mesh_keyed_fold(mesh, h1, h2, v, op.kind)
-            except ValueError:
-                raise _HostPath
+            f = mesh_keyed_refold(mesh, partials, op.kind,
+                                  nonneg=acc["nonneg"])
             del partials[:]
             partials.append(f)
 
@@ -721,12 +727,49 @@ class MTRunner(object):
                 vals = vals.astype(np.int64)
             if vals.dtype == np.float64 and not x64:
                 raise _HostPath
+            if vals.dtype.kind in "iu":
+                # The lane dtype this window will fold in: int32 with x64
+                # off (_lane_safe_values casts), the input dtype otherwise.
+                # The running bound must respect the NARROWEST lane used.
+                lane_dt = np.dtype(np.int32) if not x64 else vals.dtype
+                acc["lane_max"] = min(acc["lane_max"],
+                                      int(np.iinfo(lane_dt).max))
+                if op.kind == "sum":
+                    if x64:
+                        # values are unbounded here; a wrapped int64 np-sum
+                        # could hide an overflow, so bound with a margined
+                        # float64 over-estimate instead.
+                        s = float(np.abs(vals.astype(np.float64)).sum())
+                        acc["abs"] += s * (1 + 1e-6) + 1
+                    else:
+                        # per-window lane checks cap |v| at 2^31, so the
+                        # int64 window sum (<= 2^58) cannot wrap, and the
+                        # running total is an exact Python int
+                        acc["abs"] += int(np.abs(
+                            vals.astype(np.int64, copy=False)).sum())
+                else:
+                    m = max(abs(int(vals.min())), abs(int(vals.max()))) \
+                        if len(vals) else 0
+                    acc["abs"] = max(acc["abs"], m)
+                if acc["abs"] > acc["lane_max"]:
+                    raise _HostPath  # cross-window lane overflow: host exact
+                # The scan lowering's -1 sentinel needs SIGNED lanes and
+                # nonneg values (mesh_keyed_fold's own gate mirrors this).
+                if acc["nonneg"] and (lane_dt.kind != "i" or (
+                        len(vals) and int(vals.min()) < 0)):
+                    acc["nonneg"] = False
+            else:
+                acc["nonneg"] = False
             h1, h2 = blk.hashes()
             merge_table(blk, h1, h2)
             try:
-                f = mesh_keyed_fold(mesh, h1, h2, vals, op.kind)
+                f = mesh_keyed_fold(mesh, h1, h2, vals, op.kind, raw=True)
             except ValueError:
                 raise _HostPath
+            if acc["dtype"] is None:
+                acc["dtype"] = f[2].dtype
+            elif f[2].dtype != acc["dtype"]:
+                raise _HostPath  # mixed lane dtypes across windows
             partials.append(f)
             if len(partials) >= _PARTIAL_FANIN:
                 compact()
@@ -752,9 +795,12 @@ class MTRunner(object):
             log.info("mesh fold: falling back to the host path")
             return None
 
-        fh1 = np.asarray(partials[0][0])
-        fh2 = np.asarray(partials[0][1])
-        fv = np.asarray(partials[0][2])
+        # One fetch for the whole reduce: mask the final partial's live rows.
+        rh1, rh2, rv, rok = partials[0]
+        mask = np.asarray(rok) == 1
+        fh1 = np.asarray(rh1)[mask]
+        fh2 = np.asarray(rh2)[mask]
+        fv = np.asarray(rv)[mask]
         # Vectorized hash -> key join against the sorted table (every output
         # hash entered the table with its window).
         fu = combine64(fh1, fh2)

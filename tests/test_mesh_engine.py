@@ -121,6 +121,26 @@ class TestMeshFoldOverBudget:
                        len([i for i in range(40000) if i % 499 == k])
                        for k in range(499)}
 
+    def test_cross_window_overflow_falls_back_exact(self):
+        # Each window's values fit the 32-bit lanes but the cross-window
+        # total does not: the running host-side bound must push the fold to
+        # the exact host path instead of wrapping device partials.  7000
+        # distinct keys defeat map-side combining, so the reduce input is
+        # ~2.7MB >> the 1MB window floor — genuinely multi-window (a
+        # single-window regression cannot hide here) — while each window's
+        # own abs-sum (~43k records x 3e4 ≈ 1.3e9) stays under 2^31.
+        n, k, val = 120000, 7000, 30000  # total 3.6e9 > 2^31
+        data = [(i % k, val) for i in range(n)]
+        pipe = (Dampr.memory(data, partitions=8)
+                .a_group_by(lambda x: x[0], lambda x: x[1]).sum()
+                .checkpoint())
+        runner = MTRunner("mesh-xwindow", pipe.pmer.graph,
+                          memory_budget=1 << 16)
+        out = dict(v for _k, v in runner.run([pipe.source])[0].read())
+        want = {i: (n // k + (1 if i < n % k else 0)) * val
+                for i in range(k)}
+        assert out == want
+
     def test_min_over_budget_matches_host(self):
         data = [(i % 97, (i * 7919) % 100003) for i in range(30000)]
 
