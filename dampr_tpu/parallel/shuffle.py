@@ -306,7 +306,10 @@ def mesh_keyed_fold(mesh, h1, h2, v, kind="sum", capacity_factor=None,
             import jax.numpy as jnp
 
             z = jnp.zeros(0, jnp.uint32)
-            return z, z, jnp.asarray(np.asarray(v)[:0]), z
+            # lane-cast even when empty so the dtype matches non-empty
+            # partials a caller may mix this with in mesh_keyed_refold
+            ev = _lane_safe_values(np.asarray(v)[:0], kind)
+            return z, z, jnp.asarray(ev), z
         return (np.empty(0, np.uint32), np.empty(0, np.uint32),
                 np.asarray(v)[:0])
 

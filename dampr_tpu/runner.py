@@ -710,7 +710,7 @@ class MTRunner(object):
         # data still is): the running elementwise abs-sum bounds every
         # partial magnitude, and all windows must share one lane dtype.
         acc = {"abs": 0, "dtype": None, "nonneg": True,
-               "lane_max": 2 ** 63 - 1}
+               "lane_max": 2 ** 64}
 
         def compact():
             f = mesh_keyed_refold(mesh, partials, op.kind,
@@ -735,6 +735,9 @@ class MTRunner(object):
                 acc["lane_max"] = min(acc["lane_max"],
                                       int(np.iinfo(lane_dt).max))
                 if op.kind == "sum":
+                    # Only sums can exceed the element range across windows;
+                    # min/max results stay inside the per-window-checked
+                    # element range and need no cross-window guard.
                     if x64:
                         # values are unbounded here; a wrapped int64 np-sum
                         # could hide an overflow, so bound with a margined
@@ -747,12 +750,8 @@ class MTRunner(object):
                         # running total is an exact Python int
                         acc["abs"] += int(np.abs(
                             vals.astype(np.int64, copy=False)).sum())
-                else:
-                    m = max(abs(int(vals.min())), abs(int(vals.max()))) \
-                        if len(vals) else 0
-                    acc["abs"] = max(acc["abs"], m)
-                if acc["abs"] > acc["lane_max"]:
-                    raise _HostPath  # cross-window lane overflow: host exact
+                    if acc["abs"] > acc["lane_max"]:
+                        raise _HostPath  # cross-window overflow: host exact
                 # The scan lowering's -1 sentinel needs SIGNED lanes and
                 # nonneg values (mesh_keyed_fold's own gate mirrors this).
                 if acc["nonneg"] and (lane_dt.kind != "i" or (
