@@ -72,9 +72,8 @@ def _exchange_mesh_gate(budget):
     mode = str(settings.mesh_exchange).lower()
     if mode in ("off", "0", "false") or not settings.use_device:
         return None
-    import jax
-
-    if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+    if (mode not in ("on", "1", "true")
+            and settings.device_count_for_auto() < 2):
         return None
     from .parallel.mesh import data_mesh, mesh_size
 
@@ -622,10 +621,10 @@ class MTRunner(object):
         op = stage.reducer.op
         if op.kind not in ("sum", "min", "max"):
             return None
-        import jax
-
-        if mode not in ("on", "1", "true") and len(jax.devices()) < 2:
+        if (mode not in ("on", "1", "true")
+                and settings.device_count_for_auto() < 2):
             return None
+        import jax
 
         refs = list(entries[0].all_refs())
         if not refs:

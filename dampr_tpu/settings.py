@@ -78,15 +78,42 @@ def effective_device_min_batch():
     if device_min_batch is not None:
         return device_min_batch
     if _resolved_min_batch is None:
-        import jax
-
-        if jax.default_backend() == "cpu":
-            _resolved_min_batch = 4096
-        elif os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+        # The remote-tunnel check comes FIRST and reads only the
+        # environment: resolving via jax.default_backend() would
+        # initialize the backend, which on a tunnel-attached host is a
+        # network round-trip that can block indefinitely when the tunnel
+        # is unhealthy — the engine must never hang just to decide that
+        # host numpy is the right place for a batch.
+        if os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
             _resolved_min_batch = 1 << 22
         else:
-            _resolved_min_batch = 1 << 16
+            import jax
+
+            if jax.default_backend() == "cpu":
+                _resolved_min_batch = 4096
+            else:
+                _resolved_min_batch = 1 << 16
     return _resolved_min_batch
+
+
+def device_count_for_auto():
+    """Visible-device count for auto-mode mesh decisions, without forcing a
+    backend init through a (possibly unhealthy) remote tunnel: when no jax
+    backend is initialized yet on a tunnel-attached host, report 1 — the
+    mesh paths stay off, which is the correct call for a single tunneled
+    chip anyway.  Anywhere else (or once a backend exists) this is just
+    len(jax.devices())."""
+    import jax
+
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                return 1
+        except Exception:
+            pass  # private attr moved: fall through to the real probe
+    return len(jax.devices())
 
 
 def use_device_for(n):
