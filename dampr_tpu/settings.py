@@ -125,8 +125,9 @@ def use_device_for(n):
 
 #: Use the Pallas TPU kernel for batched string hashing (ops/pallas_fnv.py):
 #: keeps both FNV lanes VMEM-resident across the whole byte scan.  Off by
-#: default — on locally-attached TPUs it wins; through a remote-transfer
-#: tunnel the widened input upload dominates.
+#: default pending a real-chip measurement (benchmarks/pallas_bench.py runs
+#: it and the fused segmented-fold kernel against their XLA counterparts;
+#: flip this only on measured wins — no unverified perf claims).
 use_pallas = os.environ.get("DAMPR_TPU_PALLAS", "0") in ("1", "true")
 
 #: Capacity slack factor for the fixed-shape all_to_all shuffle exchange
