@@ -64,6 +64,19 @@ def get_lib():
                 ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ]
+            # Newer symbol: bind guarded so a stale cached .so (mtime-
+            # preserving deploys can skip the rebuild) degrades only this
+            # entry point, never the tokenizer fast paths it still exports.
+            try:
+                fb = lib.dampr_hash_bytes_batch
+                fb.restype = None
+                fb.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
+                    ctypes.c_void_p, ctypes.c_void_p,
+                ]
+            except AttributeError:
+                log.warning("cached native library predates "
+                            "dampr_hash_bytes_batch; rebuild to enable it")
             _lib = lib
         except Exception as exc:  # noqa: BLE001 - any failure -> numpy path
             log.warning("native tokenizer unavailable (%s); using numpy", exc)
@@ -94,6 +107,26 @@ def tokenize_hash(buf, mode, lower, want_line_ids=False):
     if want_line_ids:
         out = out + (line_ids[:count],)
     return out
+
+
+def hash_bytes_batch(bs):
+    """Dual-lane FNV over a list of bytes keys in one C pass.  Returns
+    (h1, h2) uint32 arrays, or None when the native library is
+    unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "dampr_hash_bytes_batch"):
+        return None
+    n = len(bs)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.fromiter((len(b) for b in bs), dtype=np.int64, count=n),
+              out=offs[1:])
+    buf = np.frombuffer(b"".join(bs), dtype=np.uint8)
+    h1 = np.empty(n, dtype=np.uint32)
+    h2 = np.empty(n, dtype=np.uint32)
+    lib.dampr_hash_bytes_batch(
+        np.ascontiguousarray(buf).ctypes.data, offs.ctypes.data, n,
+        h1.ctypes.data, h2.ctypes.data)
+    return h1, h2
 
 
 def token_counts(buf, mode, lower, dedup_per_line):

@@ -219,4 +219,26 @@ long dampr_token_counts(const uint8_t* buf, long n, int mode, int lower,
     return out;
 }
 
+// Batch dual-lane FNV over concatenated key bytes: key i is
+// buf[offs[i], offs[i+1]).  The host-side hash for string keys that did
+// not come from the tokenizer (re-keyed records, group keys, canonical
+// object encodings): one C pass replaces numpy's column-by-column matrix
+// scan.  Lanes match ops/hashing.py exactly.
+void dampr_hash_bytes_batch(const uint8_t* buf, const int64_t* offs,
+                            long n_keys, uint32_t* h1_out,
+                            uint32_t* h2_out) {
+    const uint32_t OFF1 = 2166136261u, OFF2 = 0x9747B28Cu;
+    const uint32_t P1 = 16777619u, P2 = 0x85EBCA6Bu;
+    for (long i = 0; i < n_keys; ++i) {
+        uint32_t h1 = OFF1, h2 = OFF2;
+        for (int64_t j = offs[i]; j < offs[i + 1]; ++j) {
+            uint8_t c = buf[j];
+            h1 = (h1 ^ c) * P1;
+            h2 = (h2 ^ c) * P2;
+        }
+        h1_out[i] = h1;
+        h2_out[i] = h2;
+    }
+}
+
 }  // extern "C"

@@ -289,11 +289,24 @@ def _join_lenprefixed(encs):
     return bytes(out)
 
 
+def _hash_bytes_list(bs):
+    """(h1, h2) for a list of bytes keys: one native C pass when available
+    (below the device-dispatch threshold), else the padded-matrix kernel.
+    Both produce identical lanes by construction."""
+    if not settings.use_device_for(len(bs)):
+        from .. import native
+
+        res = native.hash_bytes_batch(bs)
+        if res is not None:
+            return res
+    mat, lens = encode_str_keys(bs)
+    return _fnv(mat, lens)
+
+
 def _hash_object_items(items):
     """Canonical-bytes FNV for a list of arbitrary keys -> (h1, h2)."""
     encs = [encode_canonical(_freeze(k)) for k in items]
-    mat, lens = encode_str_keys(encs)
-    h1, h2 = _fnv(mat, lens)
+    h1, h2 = _hash_bytes_list(encs)
     # Tag the object lane so b"i5" (a str key) and int 5's encoding can't be
     # confused with a real str key's hash by construction alone; collisions are
     # still resolved exactly downstream, this just keeps them rare.
@@ -309,8 +322,9 @@ def _hash_kind(kind, items):
         return _mix_int(np.fromiter(
             (int(_canonical_int(k)) for k in items), dtype=np.int64, count=n))
     if kind == _K_STR:
-        mat, lens = encode_str_keys(items)
-        return _fnv(mat, lens)
+        return _hash_bytes_list(
+            [k.encode("utf-8") if isinstance(k, str) else bytes(k)
+             for k in items])
     if kind == _K_FBITS:
         return _mix_int(np.fromiter(
             (float(k) for k in items), dtype=np.float64, count=n).view(np.int64))

@@ -129,3 +129,42 @@ class TestBoolValues:
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+class TestNativeBatchHash:
+    def test_native_matches_numpy_lanes(self):
+        import dampr_tpu.native as nat
+        from dampr_tpu.ops import hashing
+
+        assert nat.get_lib() is not None, (
+            "native library must build on this rig or the parity "
+            "comparison is vacuous")
+        keys = (["tok%d" % i for i in range(500)]
+                + ["", "a", "é", "ÿ" * 300, "x" * 1025]
+                + [b"raw\x00bytes", b""])
+        with_native = hashing.hash_keys(list(keys))
+        old = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True
+        try:
+            without = hashing.hash_keys(list(keys))
+        finally:
+            nat._lib, nat._tried = old
+        import numpy as np
+        np.testing.assert_array_equal(with_native[0], without[0])
+        np.testing.assert_array_equal(with_native[1], without[1])
+
+    def test_object_lane_native_matches_numpy(self):
+        import dampr_tpu.native as nat
+        from dampr_tpu.ops import hashing
+
+        keys = [(i, "k%d" % i) for i in range(200)] + [None, frozenset({1})]
+        a = hashing.hash_keys(list(keys))
+        old = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True
+        try:
+            b = hashing.hash_keys(list(keys))
+        finally:
+            nat._lib, nat._tried = old
+        import numpy as np
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
