@@ -64,6 +64,16 @@ def get_lib():
                 ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ]
+            try:
+                fp = lib.dampr_parse_i64
+                fp.restype = ctypes.c_long
+                fp.argtypes = [
+                    ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p,
+                    ctypes.c_void_p,
+                ]
+            except AttributeError:
+                log.warning("cached native library predates "
+                            "dampr_parse_i64; rebuild to enable it")
             # Newer symbol: bind guarded so a stale cached .so (mtime-
             # preserving deploys can skip the rebuild) degrades only this
             # entry point, never the tokenizer fast paths it still exports.
@@ -107,6 +117,26 @@ def tokenize_hash(buf, mode, lower, want_line_ids=False):
     if want_line_ids:
         out = out + (line_ids[:count],)
     return out
+
+
+def parse_i64(buf):
+    """Whitespace-separated int64 parse of a uint8 buffer in one C pass.
+    Returns an int64 array, None when the native library is unavailable,
+    or raises ValueError on the first unparsable/out-of-range token
+    (numpy-parse error semantics)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "dampr_parse_i64"):
+        return None
+    buf = np.ascontiguousarray(buf)
+    n = len(buf)
+    out = np.empty(n // 2 + 1, dtype=np.int64)
+    bad = ctypes.c_long(-1)
+    count = lib.dampr_parse_i64(buf.ctypes.data, n, out.ctypes.data,
+                                ctypes.byref(bad))
+    if bad.value >= 0:
+        raise ValueError(
+            "unparsable numeric token at index {}".format(bad.value))
+    return out[:count].copy()
 
 
 def hash_bytes_batch(bs):

@@ -219,6 +219,55 @@ long dampr_token_counts(const uint8_t* buf, long n, int mode, int lower,
     return out;
 }
 
+// Whitespace-separated signed int64 parse (the external-sort ingest hot
+// path): one pass emits values; any token that is not a fully-valid
+// in-range integer sets *bad to its index and stops, so the Python caller
+// can re-raise with numpy's exact error semantics.  Matches
+// np.array(data.split(), dtype=int64) for valid input.
+long dampr_parse_i64(const uint8_t* buf, long n, int64_t* out, long* bad) {
+    long count = 0;
+    long i = 0;
+    *bad = -1;
+    const uint64_t kCut = (uint64_t)1 << 63;  // |INT64_MIN|
+    while (i < n) {
+        uint8_t b = buf[i];
+        if (b == ' ' || b == '\t' || b == '\n' || b == '\r' || b == '\v' ||
+            b == '\f') {
+            ++i;
+            continue;
+        }
+        bool neg = false;
+        if (b == '-' || b == '+') {
+            neg = (b == '-');
+            ++i;
+        }
+        uint64_t v = 0;
+        long digits = 0;
+        while (i < n) {
+            uint8_t c = buf[i];
+            if (c >= '0' && c <= '9') {
+                uint64_t nv = v * 10u + (uint64_t)(c - '0');
+                if (v > (kCut / 10u) || nv < v) { *bad = count; return count; }
+                v = nv;
+                ++digits;
+                ++i;
+            } else if (c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+                       c == '\v' || c == '\f') {
+                break;
+            } else {
+                *bad = count;  // junk inside the token
+                return count;
+            }
+        }
+        if (digits == 0 || v > (neg ? kCut : kCut - 1)) {
+            *bad = count;
+            return count;
+        }
+        out[count++] = neg ? (int64_t)(~v + 1u) : (int64_t)v;
+    }
+    return count;
+}
+
 // Batch dual-lane FNV over concatenated key bytes: key i is
 // buf[offs[i], offs[i+1]).  The host-side hash for string keys that did
 // not come from the tokenizer (re-keyed records, group keys, canonical

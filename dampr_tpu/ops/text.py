@@ -268,11 +268,19 @@ class ParseNumbers(Mapper):
         self.dtype = np.dtype(dtype)
 
     def map_blocks(self, dataset):
+        from .. import native
         from ..blocks import Block
 
         data = dataset.read_bytes()
         if not data:
             return
+        if self.dtype == np.int64:
+            # one native pass: no 50M-element Python token list
+            arr = native.parse_i64(np.frombuffer(data, dtype=np.uint8))
+            if arr is not None:
+                if len(arr):
+                    yield Block(arr, arr.copy())
+                return
         toks = data.split()
         if not toks:
             return

@@ -155,6 +155,11 @@ mesh_exchange = os.environ.get("DAMPR_TPU_MESH_EXCHANGE", "auto")
 #: chunk computes.  0 disables.  See inputs.Readahead.
 readahead_chunks = int(os.environ.get("DAMPR_TPU_READAHEAD", "2"))
 
+#: Spill compression policy: "auto" (default) gzips object-lane blocks and
+#: writes fully-numeric blocks plain (high-entropy lanes don't compress and
+#: the gzip pass is core-bound both ways); "always"/"never" force it.
+spill_compress = os.environ.get("DAMPR_TPU_SPILL_COMPRESS", "auto")
+
 #: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
 #: base.py:435-469).
 scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")

@@ -113,13 +113,24 @@ SPILL_WINDOW = 16384
 
 
 def save_block(block, path):
-    """Spill wire format: a sequence of pickled columnar windows inside one
-    gzip stream.  Windowing keeps spilled blocks *streamable* — merge readers
-    hold one window per run — while numeric lanes still serialize as raw
-    buffers (pickle protocol 5); same gzip+pickle tradeoff as the reference's
-    batched streams (dataset.py:20-41) but columnar."""
+    """Spill wire format: a sequence of pickled columnar windows, inside one
+    gzip stream for object-lane blocks or as a plain stream for fully
+    numeric ones.  Windowing keeps spilled blocks *streamable* — merge
+    readers hold one window per run — while numeric lanes serialize as raw
+    buffers (pickle protocol 5).  Numeric columns (hashes, parsed numbers,
+    counts) are mostly high-entropy, so gzip buys little and costs a
+    core-bound pass each way — they spill uncompressed at disk bandwidth
+    (``settings.spill_compress`` = "always"/"never" overrides the
+    heuristic); readers sniff the gzip magic, so both formats coexist."""
     n = len(block)
-    with gzip.open(path, "wb", compresslevel=settings.compress_level) as f:
+    mode = str(settings.spill_compress).lower()
+    numeric = (block.keys.dtype != object and block.values.dtype != object)
+    plain = mode == "never" or (mode not in ("always", "1", "true")
+                                and numeric)
+    opener = (lambda: open(path, "wb")) if plain else (
+        lambda: gzip.open(path, "wb",
+                          compresslevel=settings.compress_level))
+    with opener() as f:
         for at in range(0, max(n, 1), SPILL_WINDOW):
             end = min(at + SPILL_WINDOW, n)
             pickle.dump(
@@ -130,10 +141,14 @@ def save_block(block, path):
 
 
 def iter_block_windows(path):
-    """Stream a spilled block back window by window (bounded memory)."""
+    """Stream a spilled block back window by window (bounded memory).
+    Sniffs the gzip magic so compressed and plain spills coexist."""
     from .blocks import Block
 
-    with gzip.open(path, "rb") as f:
+    with open(path, "rb") as raw:
+        magic = raw.read(2)
+        raw.seek(0)
+        f = gzip.GzipFile(fileobj=raw) if magic == b"\x1f\x8b" else raw
         while True:
             try:
                 keys, values, h1, h2 = pickle.load(f)

@@ -213,3 +213,34 @@ class TestReviewRegressions:
             for i in range(1000):
                 f.write("line %d\n" % i)
         assert Dampr.text(p).len().read() == [1000]
+
+
+class TestNativeParse:
+    def test_parse_i64_matches_numpy(self):
+        import dampr_tpu.native as nat
+
+        assert nat.get_lib() is not None
+        data = b"3\n-17\n0\n+9\n9223372036854775807\n-9223372036854775808\n"
+        arr = nat.parse_i64(np.frombuffer(data, dtype=np.uint8))
+        want = np.array(data.split(), dtype=np.int64)
+        np.testing.assert_array_equal(arr, want)
+
+    def test_parse_i64_rejects_junk_and_overflow(self):
+        import dampr_tpu.native as nat
+
+        for bad in (b"1\nx\n", b"12a\n", b"9223372036854775808\n",
+                    b"-9223372036854775809\n", b"-\n"):
+            with pytest.raises(ValueError):
+                nat.parse_i64(np.frombuffer(bad, dtype=np.uint8))
+
+    def test_parse_numbers_block_path_exact(self):
+        class _Bytes:
+            def __init__(self, data):
+                self._data = data
+
+            def read_bytes(self):
+                return self._data
+
+        p = T.ParseNumbers()
+        blocks = list(p.map_blocks(_Bytes(b"5\n-2\n7\n")))
+        assert sorted(v for _k, v in blocks[0].iter_pairs()) == [-2, 5, 7]
