@@ -184,6 +184,38 @@ class TextLineDataset(Dataset):
             f.seek(real_start)
             return f.read(real_end - real_start)
 
+    def iter_byte_blocks(self, block_size=4 * 1024 ** 2):
+        """Stream the chunk's owned bytes in bounded blocks (same ownership
+        contract as read_bytes) — scanning consumers (record counting)
+        never materialize the whole range."""
+        with open(self.path, "rb") as f:
+            real_start = self.start
+            if self.start > 0:
+                f.seek(self.start)
+                f.readline()
+                real_start = f.tell()
+            f.seek(real_start)
+            if self.end is None:
+                while True:
+                    b = f.read(block_size)
+                    if not b:
+                        return
+                    yield b
+                return
+            if real_start > self.end:
+                return
+            at = real_start
+            while at < self.end:
+                b = f.read(min(block_size, self.end - at))
+                if not b:
+                    return
+                at += len(b)
+                yield b
+            # extend through the line crossing `end`
+            tail = f.readline()
+            if tail:
+                yield tail
+
     def __repr__(self):
         return "Text[path={},start={},end={}]".format(
             self.path, self.start, self.end)
