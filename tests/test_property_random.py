@@ -119,7 +119,7 @@ _CHAIN_OPS = [_op_map, _op_stringify, _op_filter, _op_flat_map]
 _TERMINALS = [_op_count, _op_fold_min, _op_group_reduce, _op_sort, _op_len]
 
 
-def _run_case(seed):
+def _run_case(seed, budget=None):
     rng = random.Random(seed)
     data = _gen_data(rng)
     pipe = Dampr.memory(list(data), partitions=rng.choice([2, 5, 8]))
@@ -132,7 +132,8 @@ def _run_case(seed):
     pipe = eng(pipe)
     want = orc(oracle)
 
-    got = list(pipe.run("prop-%d" % seed).read())
+    kwargs = {} if budget is None else {"memory_budget": budget}
+    got = list(pipe.run("prop-%d" % seed, **kwargs).read())
     return got, want
 
 
@@ -142,4 +143,12 @@ class TestRandomPipelines:
         got, want = _run_case(seed)
         # terminal outputs: count/fold/group emit (k, v) values keyed by k;
         # sort/len emit plain values.  Compare as sorted collections.
+        assert sorted(map(repr, got)) == sorted(map(repr, want)), seed
+
+    @pytest.mark.parametrize("seed", range(0, 60, 2))
+    def test_random_pipeline_tiny_budget(self, seed):
+        # A 16KB budget forces spills, streamed merges, and windowed
+        # exchanges through the same random pipelines; results must not
+        # change by a byte.
+        got, want = _run_case(seed, budget=1 << 14)
         assert sorted(map(repr, got)) == sorted(map(repr, want)), seed
