@@ -26,9 +26,13 @@ def main():
                     help="force the virtual CPU mesh")
     args = ap.parse_args()
 
+    import os
+
     import jax
 
-    if args.cpu:
+    # honor --cpu and a JAX_PLATFORMS=cpu request even where the TPU plugin
+    # programmatically overrides jax_platforms at interpreter start
+    if args.cpu or "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
         jax.config.update("jax_platforms", "cpu")
 
     from dampr_tpu.ops import hashing
