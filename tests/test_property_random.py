@@ -115,8 +115,51 @@ def _op_len(rng):
     return (lambda p: p.len(), lambda xs: [len(xs)], True)
 
 
+def _op_topk(rng):
+    k = rng.randrange(1, 6)
+    return (lambda p: p.map(lambda x: len(str(x))).topk(k),
+            lambda xs: sorted((len(str(x)) for x in xs), reverse=True)[:k],
+            True)
+
+
+def _op_mean(rng):
+    def orc(xs):
+        if not xs:
+            return []
+        vs = [len(str(x)) for x in xs]
+        d = {}
+        for v in vs:
+            s, c = d.get(v % 3, (0, 0))
+            d[v % 3] = (s + v, c + 1)
+        return sorted((k, s / float(c)) for k, (s, c) in d.items())
+
+    return (lambda p: p.map(lambda x: len(str(x)))
+            .mean(lambda v: v % 3, lambda v: v), orc, True)
+
+
+def _op_join(rng):
+    def eng(p):
+        left = p.group_by(lambda x: str(x)[:1])
+        right = (p.map(lambda x: str(x))
+                 .group_by(lambda s: s[:1]))
+        return left.join(right).reduce(
+            lambda l, r: (len(list(l)), len(list(r))))
+
+    def orc(xs):
+        lg, rg = {}, {}
+        for x in xs:
+            lg.setdefault(str(x)[:1], []).append(x)
+        for x in xs:
+            rg.setdefault(str(x)[:1], []).append(str(x))
+        return sorted((k, (len(lg[k]), len(rg[k])))
+                      for k in set(lg) & set(rg))
+
+    return (eng, orc, True)
+
+
 _CHAIN_OPS = [_op_map, _op_stringify, _op_filter, _op_flat_map]
-_TERMINALS = [_op_count, _op_fold_min, _op_group_reduce, _op_sort, _op_len]
+_TERMINALS = [_op_count, _op_fold_min, _op_group_reduce, _op_sort, _op_len,
+              _op_topk, _op_mean, _op_join]
 
 
 def _run_case(seed, budget=None):
