@@ -109,8 +109,12 @@ def run_dampr_tpu(corpus, outdir):
     chunk_size = os.path.getsize(corpus) // multiprocessing.cpu_count() + 1
     t0 = time.time()
     docs = Dampr.text(corpus, chunk_size)
-    doc_freq = (docs.custom_mapper(DocFreq(mode="word", lower=True))
-                .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
+    # pair_values=False + fold_values: blocks keep their token keys, cached
+    # hash lanes, and a numeric count column end-to-end — zero per-record
+    # Python between the native tokenizer and the (device-eligible) fold
+    doc_freq = (docs.custom_mapper(
+        DocFreq(mode="word", lower=True, pair_values=False))
+        .fold_values(operator.add))
     idf = doc_freq.cross_right(
         docs.len(),
         lambda df, total: (df[0], df[1],

@@ -204,6 +204,17 @@ class PMap(PBase):
         """Shortcut for ``a_group_by(key, value).reduce(binop)``."""
         return self.a_group_by(key, value).reduce(binop, **options)
 
+    def fold_values(self, binop, **options):
+        """Fold values by each record's EXISTING key — no re-key map pass.
+        Blocks flow into the combine with their cached hash lanes and
+        (numeric) value lanes intact, so the whole aggregation stays on the
+        vectorized path with zero per-record Python.  Use after block
+        mappers that already emit records keyed by the group key
+        (ops.text.TokenCounts/DocFreq with ``pair_values=False``).  Beyond
+        the reference surface: its fold_by always re-keys per record
+        (reference dampr.py:406-410)."""
+        return ARReduce(self).reduce(binop, **options)
+
     def sort_by(self, key, **options):
         """Globally sort values by a key function (results merge key-sorted)."""
         def _sort_by(_key, value):

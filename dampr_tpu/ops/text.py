@@ -72,7 +72,8 @@ def _token_bounds(buf, mode):
 _SHORT_TOKEN = 255
 
 
-def _numpy_counts_block(data, mode, lower, dedup_per_line):
+def _numpy_counts_block(data, mode, lower, dedup_per_line,
+                        pair_values=True):
     """Pure-numpy fallback for the fused native pass.  Exact by construction:
     grouping is ``np.unique`` over length-prefixed token byte rows (not over
     hashes), so colliding hashes can never merge distinct tokens."""
@@ -138,10 +139,15 @@ def _numpy_counts_block(data, mode, lower, dedup_per_line):
 
     ng = len(keys)
     kcol = np.empty(ng, dtype=object)
-    vcol = np.empty(ng, dtype=object)
-    for i in range(ng):
-        kcol[i] = keys[i]
-        vcol[i] = (keys[i], counts[i])
+    if pair_values:
+        vcol = np.empty(ng, dtype=object)
+        for i in range(ng):
+            kcol[i] = keys[i]
+            vcol[i] = (keys[i], counts[i])
+    else:
+        for i in range(ng):
+            kcol[i] = keys[i]
+        vcol = np.asarray(counts, dtype=np.int64)
     h1, h2 = hashing.hash_keys(kcol)
     return Block(kcol, vcol, h1, h2)
 
@@ -151,7 +157,8 @@ def _numpy_counts_block(data, mode, lower, dedup_per_line):
 _ASCII_LOWER = bytes.maketrans(bytes(range(65, 91)), bytes(range(97, 123)))
 
 
-def _native_counts_block(data, mode, lower, dedup_per_line):
+def _native_counts_block(data, mode, lower, dedup_per_line,
+                         pair_values=True):
     """Fused native tokenize(+case-fold)+count -> Block, or None.  Case
     folding happens inside the native hash pass; representative strings
     ASCII-fold the original bytes (vocabulary-sized work instead of a
@@ -168,7 +175,10 @@ def _native_counts_block(data, mode, lower, dedup_per_line):
     h1, h2, counts, rep_start, rep_len = res
     n = len(h1)
     keys = np.empty(n, dtype=object)
-    vals = np.empty(n, dtype=object)
+    if pair_values:
+        vals = np.empty(n, dtype=object)
+    else:
+        vals = np.asarray(counts, dtype=np.int64)
     lossy = []
     for i in range(n):
         s = rep_start[i]
@@ -177,7 +187,8 @@ def _native_counts_block(data, mode, lower, dedup_per_line):
             raw = raw.translate(_ASCII_LOWER)
         tok = raw.decode("utf-8", "replace")
         keys[i] = tok
-        vals[i] = (tok, int(counts[i]))
+        if pair_values:
+            vals[i] = (tok, int(counts[i]))
         if "�" in tok:
             lossy.append(i)
     if lossy:
@@ -196,20 +207,25 @@ def _native_counts_block(data, mode, lower, dedup_per_line):
     return Block(keys, vals, h1, h2)
 
 
-def chunk_token_counts(data, mode="whitespace", lower=False):
+def chunk_token_counts(data, mode="whitespace", lower=False,
+                       pair_values=True):
     """bytes -> Block of (token, count) with cached hash lanes."""
-    blk = _native_counts_block(data, mode, lower, dedup_per_line=0)
+    blk = _native_counts_block(data, mode, lower, dedup_per_line=0,
+                               pair_values=pair_values)
     if blk is not None:
         return blk
-    return _numpy_counts_block(data, mode, lower, dedup_per_line=0)
+    return _numpy_counts_block(data, mode, lower, dedup_per_line=0,
+                               pair_values=pair_values)
 
 
-def chunk_doc_freq(data, mode="word", lower=True):
+def chunk_doc_freq(data, mode="word", lower=True, pair_values=True):
     """bytes -> Block of (token, n_lines_containing) — per-line dedup then
     count, i.e. ``flat_map(lambda line: set(tokenize(line))).count()``."""
-    blk = _native_counts_block(data, mode, lower, dedup_per_line=1)
+    blk = _native_counts_block(data, mode, lower, dedup_per_line=1,
+                               pair_values=pair_values)
     if blk is None:
-        blk = _numpy_counts_block(data, mode, lower, dedup_per_line=1)
+        blk = _numpy_counts_block(data, mode, lower, dedup_per_line=1,
+                                  pair_values=pair_values)
     if any(isinstance(k, str) and "�" in k for k in blk.keys):
         # Lossy decode breaks the per-line *set* contract: distinct invalid
         # byte tokens on one line all materialize as the same U+FFFD string,
@@ -218,9 +234,12 @@ def chunk_doc_freq(data, mode="word", lower=True):
         # (A legitimate U+FFFD round-trips, so this re-run is idempotent.)
         clean = data.decode("utf-8", "replace").encode("utf-8")
         if clean != data:
-            blk = _native_counts_block(clean, mode, lower, dedup_per_line=1)
+            blk = _native_counts_block(clean, mode, lower, dedup_per_line=1,
+                                       pair_values=pair_values)
             if blk is None:
-                blk = _numpy_counts_block(clean, mode, lower, dedup_per_line=1)
+                blk = _numpy_counts_block(clean, mode, lower,
+                                          dedup_per_line=1,
+                                          pair_values=pair_values)
     return blk
 
 
@@ -304,13 +323,17 @@ class TokenCounts(Mapper):
     kv: kv[0], operator.add, lambda kv: kv[1])`` for the global count — its
     Python cost is vocabulary-sized, not corpus-sized."""
 
-    def __init__(self, mode="whitespace", lower=False):
+    def __init__(self, mode="whitespace", lower=False, pair_values=True):
         self.mode = mode
         self.lower = lower
+        #: pair_values=False emits plain int counts as values (keys stay the
+        #: tokens) — pair with PMap.fold_values for the zero-per-record path.
+        self.pair_values = pair_values
 
     def map_blocks(self, dataset):
         data = dataset.read_bytes()
-        yield chunk_token_counts(data, self.mode, self.lower)
+        yield chunk_token_counts(data, self.mode, self.lower,
+                                 self.pair_values)
 
     def map(self, *datasets):
         # exact per-record fallback for datasets without raw bytes
@@ -325,20 +348,24 @@ class TokenCounts(Mapper):
                 line = line.lower()
             toks = rx.split(line) if rx else line.split()
             counts.update(t for t in toks if t)
-        return iter((t, (t, c)) for t, c in counts.items())
+        if self.pair_values:
+            return iter((t, (t, c)) for t, c in counts.items())
+        return iter(counts.items())
 
 
 class DocFreq(Mapper):
     """Vectorized per-line token document frequency (the reference TF-IDF
     benchmark's hot map: tf-idf-dampr.py:13-15)."""
 
-    def __init__(self, mode="word", lower=True):
+    def __init__(self, mode="word", lower=True, pair_values=True):
         self.mode = mode
         self.lower = lower
+        self.pair_values = pair_values
 
     def map_blocks(self, dataset):
         data = dataset.read_bytes()
-        yield chunk_doc_freq(data, self.mode, self.lower)
+        yield chunk_doc_freq(data, self.mode, self.lower,
+                             self.pair_values)
 
     def map(self, *datasets):
         assert len(datasets) == 1
@@ -352,4 +379,6 @@ class DocFreq(Mapper):
                 line = line.lower()
             toks = rx.split(line) if rx else line.split()
             counts.update(set(t for t in toks if t))
-        return iter((t, (t, c)) for t, c in counts.items())
+        if self.pair_values:
+            return iter((t, (t, c)) for t, c in counts.items())
+        return iter(counts.items())

@@ -244,3 +244,42 @@ class TestNativeParse:
         p = T.ParseNumbers()
         blocks = list(p.map_blocks(_Bytes(b"5\n-2\n7\n")))
         assert sorted(v for _k, v in blocks[0].iter_pairs()) == [-2, 5, 7]
+
+
+class TestFoldValues:
+    def test_fold_values_matches_fold_by(self, tmp_path):
+        p = str(tmp_path / "c.txt")
+        data = (open("/root/reference/README.md").read()) * 9
+        open(p, "w").write(data)
+        fast = dict(
+            Dampr.text(p, chunk_size=8192)
+            .custom_mapper(T.DocFreq(pair_values=False))
+            .fold_values(operator.add).read())
+        slow = dict(
+            Dampr.text(p, chunk_size=8192)
+            .custom_mapper(T.DocFreq())
+            .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+            .read())
+        assert fast == slow
+
+    def test_pair_values_false_block_is_numeric(self):
+        blk = T.chunk_doc_freq(SAMPLE, pair_values=False)
+        assert blk.values.dtype == np.int64
+        from dampr_tpu.ops import hashing
+        kh1, _ = hashing.hash_keys(blk.keys)
+        np.testing.assert_array_equal(np.asarray(blk.h1), kh1)
+
+    def test_fold_values_per_record_fallback(self):
+        lines = ["a b a", "b c"]
+        got = dict(Dampr.memory(lines)
+                   .custom_mapper(T.TokenCounts(pair_values=False))
+                   .fold_values(operator.add).read())
+        assert got == {"a": 2, "b": 2, "c": 1}
+
+    def test_fold_values_output_value_shape(self, tmp_path):
+        p = str(tmp_path / "v.txt")
+        open(p, "w").write("x y x\n")
+        vals = (Dampr.text(p)
+                .custom_mapper(T.TokenCounts(pair_values=False))
+                .fold_values(operator.add).run().read())
+        assert sorted(vals) == [("x", 2), ("y", 1)]
