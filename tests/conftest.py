@@ -20,6 +20,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Initialize the CPU backend eagerly: auto-mode mesh decisions
+# (settings.device_count_for_auto) deliberately refuse to initialize a
+# backend on tunnel-attached hosts, so without this a test that runs first
+# in a fresh process would see "1 device" and skip the mesh paths.
+jax.devices()
 
 # Small blocks should still exercise the device path in tests: pin the
 # dispatch threshold so backend-specific auto-resolution never de-targets

@@ -116,3 +116,36 @@ class TestSplitterAgreement:
         sp = Splitter()
         for i, k in enumerate(keys):
             assert sp.partition(k, 13) == int(pids[i])
+
+
+class TestRetriesWithExchange:
+    def test_flaky_reducer_retried_through_mesh_exchange(self):
+        from dampr_tpu import Dampr, settings
+        from dampr_tpu.runner import MTRunner
+
+        old = (settings.partitions, settings.mesh_exchange,
+               settings.mesh_fold, settings.job_retries)
+        settings.partitions = 4
+        settings.mesh_exchange = "auto"
+        settings.mesh_fold = "off"
+        settings.job_retries = 1
+        fails = {"left": 1}
+
+        def flaky(k, vs):
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise RuntimeError("transient")
+            return sorted(vs)[:2]
+
+        try:
+            pipe = (Dampr.memory(list(range(200)), partitions=4)
+                    .group_by(lambda x: x % 3).reduce(flaky))
+            runner = MTRunner("flaky-exchange", pipe.pmer.graph)
+            out = dict(v for v in runner.run([pipe.source])[0].read())
+            assert runner.mesh_exchanges >= 1
+            want = {k: (k, sorted(x for x in range(200) if x % 3 == k)[:2])
+                    for k in range(3)}
+            assert out == want
+        finally:
+            (settings.partitions, settings.mesh_exchange,
+             settings.mesh_fold, settings.job_retries) = old
