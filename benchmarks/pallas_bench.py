@@ -72,15 +72,13 @@ def bench_fnv(iters):
     }
 
 
-def bench_segfold(iters):
+def bench_segfold(iters, n=1 << 22):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from dampr_tpu.ops import pallas_segfold as SF
     from dampr_tpu.parallel.shuffle import _local_fold
-
-    n = 1 << 22
 
     def gen_sorted(seed):
         key = jax.random.PRNGKey(seed)
@@ -149,17 +147,23 @@ def bench_segfold(iters):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--records", type=int, default=1 << 22,
+                    help="segfold record count (multiple of the tile size)")
     ap.add_argument("--only", choices=["fnv", "segfold"])
     args = ap.parse_args()
 
     import jax
 
-    out = {"metric": "pallas_vs_xla", "backend": jax.default_backend()}
+    # One JSON line per section, flushed immediately: a flaky accelerator
+    # tunnel can kill the later (bigger) section without losing the first.
+    base = {"metric": "pallas_vs_xla", "backend": jax.default_backend()}
     if args.only in (None, "fnv"):
-        out["fnv"] = bench_fnv(args.iters)
+        r = dict(base, kernel="fnv", **bench_fnv(args.iters))
+        print(json.dumps(r), flush=True)
     if args.only in (None, "segfold"):
-        out["segfold"] = bench_segfold(args.iters)
-    print(json.dumps(out))
+        r = dict(base, kernel="segfold",
+                 **bench_segfold(args.iters, args.records))
+        print(json.dumps(r), flush=True)
 
 
 if __name__ == "__main__":
