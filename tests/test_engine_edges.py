@@ -149,3 +149,33 @@ class TestRetriesWithExchange:
         finally:
             (settings.partitions, settings.mesh_exchange,
              settings.mesh_fold, settings.job_retries) = old
+
+
+class TestMultiOutputUnderPressure:
+    def test_shared_prefix_multi_output_tiny_budget(self):
+        from dampr_tpu import Dampr, settings
+        from dampr_tpu.runner import MTRunner
+
+        old = (settings.partitions, settings.mesh_exchange,
+               settings.mesh_fold)
+        settings.partitions = 8
+        settings.mesh_exchange = "auto"
+        settings.mesh_fold = "auto"
+        try:
+            base = Dampr.memory(list(range(4000)), partitions=8).map(
+                lambda x: x * 3)
+            counts = base.count(lambda x: x % 5)
+            total = base.len()
+            mx = base.a_group_by(lambda x: x % 7).reduce(max)
+            outs = Dampr.run(counts, total, mx, memory_budget=1 << 15)
+            got_counts = dict(outs[0].read())
+            assert got_counts == {i: 800 for i in range(5)}
+            assert list(outs[1].read()) == [4000]
+            got_mx = dict(outs[2].read())
+            want_mx = {k: max(x * 3 for x in range(4000)
+                              if (x * 3) % 7 == k)
+                       for k in set((x * 3) % 7 for x in range(4000))}
+            assert got_mx == want_mx
+        finally:
+            (settings.partitions, settings.mesh_exchange,
+             settings.mesh_fold) = old
