@@ -1,0 +1,11 @@
+"""Make `python benchmarks/<script>.py` work from anywhere: the script's
+own directory (benchmarks/) is what Python puts on sys.path, so the repo
+root — where the dampr_tpu package lives — is inserted here once, and every
+benchmark script just does `import _pathfix  # noqa: F401`."""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)

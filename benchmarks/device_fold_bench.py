@@ -24,6 +24,8 @@ and compared exactly against a host-side np.bincount of the identical
     python benchmarks/device_fold_bench.py [--records 2**22] [--keys 65536]
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import argparse
 import functools
 import json

@@ -16,6 +16,8 @@ verified against the XLA/host reference for the same inputs.
     python benchmarks/pallas_bench.py [--iters 20]
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import argparse
 import json
 import time
@@ -29,9 +31,10 @@ def bench_fnv(iters):
     from jax import lax
 
     from dampr_tpu.ops.hashing import _fnv_jit
-    from dampr_tpu.ops.pallas_fnv import fnv_pallas
+    from dampr_tpu.ops.pallas_fnv import _ROW_TILE, _build, fnv_pallas
 
     n, L = 1 << 17, 16  # 128k tokens, 16-byte pad bucket (typical words)
+    assert n % _ROW_TILE == 0
 
     def gen(seed):
         key = jax.random.PRNGKey(seed)
@@ -41,16 +44,29 @@ def bench_fnv(iters):
                                   dtype=jnp.int32)
         return mat, lens
 
-    # verify parity once
+    # device-side pallas entry: same layout prep as fnv_pallas's host
+    # wrapper, but traced, so the timed loop never leaves the chip
+    pallas_run = _build(L, False)
+
+    def pallas_dev(m, l):
+        h1, h2 = pallas_run(m.T.astype(jnp.int32), l.reshape(1, n))
+        return (h1.reshape(n).view(jnp.uint32),
+                h2.reshape(n).view(jnp.uint32))
+
+    # verify the host wrapper AND the exact device entry the loop times
     mat, lens = gen(0)
     a1, a2 = _fnv_jit()(mat, lens)
     b1, b2 = fnv_pallas(np.asarray(mat), np.asarray(lens))
     assert (np.asarray(a1) == np.asarray(b1)).all()
     assert (np.asarray(a2) == np.asarray(b2)).all()
+    d1, d2 = jax.jit(pallas_dev)(mat, lens)
+    assert (np.asarray(a1) == np.asarray(d1)).all()
+    assert (np.asarray(a2) == np.asarray(d2)).all()
 
     results = {}
+    checks = {}
     for name, fn in (("xla", lambda m, l: _fnv_jit()(m, l)),
-                     ("pallas", fnv_pallas)):
+                     ("pallas", pallas_dev)):
         def loop(seed0, fn=fn):
             def body(i, acc):
                 m, l = gen(seed0 + i)
@@ -60,10 +76,12 @@ def bench_fnv(iters):
             return lax.fori_loop(0, iters, body, jnp.uint32(0))
 
         jl = jax.jit(loop)
-        jax.device_get(jl(0))
+        checks[name] = int(jax.device_get(jl(0)))
         t0 = time.time()
         jax.device_get(jl(100))
         results[name] = (time.time() - t0) / iters
+    # same seeds, same hash definition: the warmup checksums must agree
+    assert checks["xla"] == checks["pallas"], checks
     return {
         "tokens": n,
         "xla_Mtok_s": round(n / results["xla"] / 1e6, 1),
@@ -123,6 +141,7 @@ def bench_segfold(iters, n=1 << 22):
         return tot[0, 0]
 
     results = {}
+    checks = {}
     for name, fn in (("xla_scan", xla_chain), ("pallas", pallas_chain)):
         def loop(seed0, fn=fn):
             def body(i, acc):
@@ -132,10 +151,13 @@ def bench_segfold(iters, n=1 << 22):
             return lax.fori_loop(0, iters, body, jnp.int32(0))
 
         jl = jax.jit(loop)
-        jax.device_get(jl(0))
+        checks[name] = int(jax.device_get(jl(0)))
         t0 = time.time()
         jax.device_get(jl(100))
         results[name] = (time.time() - t0) / iters
+    # both chains define tot identically (segment totals at end positions),
+    # so the warmup checksums over identical seeds must agree
+    assert checks["xla_scan"] == checks["pallas"], checks
     return {
         "records": n,
         "xla_scan_Mrec_s": round(n / results["xla_scan"] / 1e6, 1),

@@ -10,6 +10,8 @@ functional check:
         python benchmarks/shuffle_bench.py --cpu
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import argparse
 import json
 import time

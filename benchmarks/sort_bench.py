@@ -6,6 +6,8 @@ completeness, and report sustained throughput plus spill counters.
     python benchmarks/sort_bench.py --mb 512 --budget-mb 64
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import argparse
 import json
 import os

@@ -162,16 +162,14 @@ def _use_device(n):
 
 
 def _fnv(mat, lens):
+    # Measured on a real v5e (benchmarks/pallas_bench.py, round 3): the
+    # Pallas VMEM-resident kernel (ops/pallas_fnv.py) runs at 0.58x the
+    # portable _fnv_jit path (43.5 vs 74.7 Mtok/s at 128k x 16B tokens), so
+    # there is no production dispatch to it — the kernel remains only as a
+    # benchmarked negative result.
     n = mat.shape[0]
     if not _use_device(n):
         return _fnv_numpy(mat, lens)
-    if settings.use_pallas:
-        import jax
-        if jax.default_backend() not in ("cpu", "gpu"):
-            # Mosaic lowering is TPU-only; other backends keep the
-            # portable _fnv_jit path below.
-            from .pallas_fnv import fnv_pallas
-            return fnv_pallas(mat, lens)
     np_rows = _pow2_rows(n)
     if np_rows != n:
         mat = np.pad(mat, ((0, np_rows - n), (0, 0)))

@@ -59,22 +59,44 @@ def _build_kernel():
         col0 = prev_row_last
         return jnp.concatenate([col0, lanes[:, 1:]], axis=1)
 
+    def _scan(x, op, pad, axis):
+        """Inclusive Hillis-Steele scan along one axis of a 2-D tile.
+        Mosaic's TC lowering (this jax version) has no cumsum/cummax
+        primitive, so the scan is log-depth shifted-operand steps built
+        from concatenate/slice — which do lower."""
+        n = x.shape[axis]
+        d = 1
+        while d < n:
+            if axis == 1:
+                pads = jnp.full((x.shape[0], d), pad, x.dtype)
+                shifted = jnp.concatenate([pads, x[:, :-d]], axis=1)
+            else:
+                pads = jnp.full((d, x.shape[1]), pad, x.dtype)
+                shifted = jnp.concatenate([pads, x[:-d, :]], axis=0)
+            x = op(x, shifted)
+            d *= 2
+        return x
+
     def flat_cumsum(x):
         """Inclusive prefix sum of an (R, L) int32 tile in flattened
         row-major order: lane scan + carried row offsets."""
-        row = jnp.cumsum(x, axis=1)
-        row_tot = row[:, -1:]
-        row_off = jnp.cumsum(row_tot, axis=0) - row_tot
-        return row + row_off
+        row = _scan(x, jnp.add, 0, axis=1)
+        # per-row totals broadcast across lanes, then scanned over rows so
+        # the sublane scan runs at full lane width (a (R, 1) operand would
+        # fight the (8, 128) tiling)
+        row_tot = jnp.broadcast_to(row[:, -1:], x.shape)
+        row_off_incl = _scan(row_tot, jnp.add, 0, axis=0)
+        return row + (row_off_incl - row_tot)
 
     def flat_cummax(x):
         """Inclusive prefix max, flattened row-major order."""
-        row = lax.cummax(x, axis=1)
-        row_max = row[:, -1:]
-        row_carry = lax.cummax(row_max, axis=0)
+        neg = jnp.iinfo(x.dtype).min
+        row = _scan(x, jnp.maximum, neg, axis=1)
+        row_max = jnp.broadcast_to(row[:, -1:], x.shape)
+        row_carry = _scan(row_max, jnp.maximum, neg, axis=0)
         prev_rows = jnp.concatenate(
-            [jnp.full((1, 1), jnp.iinfo(x.dtype).min, x.dtype),
-             row_carry[:-1]], axis=0)
+            [jnp.full((1, x.shape[1]), neg, x.dtype), row_carry[:-1]],
+            axis=0)
         return jnp.maximum(row, prev_rows)
 
     def kernel(h1_ref, h2_ref, v_ref, inv_ref, nh1_ref, nh2_ref, ninv_ref,

@@ -123,13 +123,6 @@ def use_device_for(n):
         return False
     return n >= effective_device_min_batch()
 
-#: Use the Pallas TPU kernel for batched string hashing (ops/pallas_fnv.py):
-#: keeps both FNV lanes VMEM-resident across the whole byte scan.  Off by
-#: default pending a real-chip measurement (benchmarks/pallas_bench.py runs
-#: it and the fused segmented-fold kernel against their XLA counterparts;
-#: flip this only on measured wins — no unverified perf claims).
-use_pallas = os.environ.get("DAMPR_TPU_PALLAS", "0") in ("1", "true")
-
 #: Capacity slack factor for the fixed-shape all_to_all shuffle exchange
 #: (MoE-style capacity: per-(src,dst) buffer = ceil(N/D) * factor).
 shuffle_capacity_factor = 1.5
