@@ -83,6 +83,49 @@ class TestChunkKernels:
         assert len(got) == 200000
         assert all(v == 1 for v in got.values())
 
+    def test_adversarial_corpora_vs_python_oracle(self):
+        # Shapes that have bitten the native scan: token runs filling whole
+        # 64-byte blocks (the SIMD walk's all-ones mask was a ctzll(0)
+        # infinite loop), runs ending exactly at block edges, random
+        # non-UTF-8 bytes, and case folding at every position.
+        fold_tbl = bytes((b + 32) if 65 <= b <= 90 else b
+                         for b in range(256))
+        rng = np.random.RandomState(7)
+        corpora = [
+            bytes(rng.randint(0, 256, 20000, dtype=np.uint8)),
+            bytes(rng.randint(60, 128, 60000, dtype=np.uint8)),  # dense runs
+            b"a" * 64, b"a" * 65, b"Aa " * 21 + b"aA",
+            ("A" * 200 + "\n" + "b" * 63 + " " + "Z" * 64 + "\n"
+             ).encode() * 50,
+        ]
+        if native.get_lib() is None:
+            pytest.skip("native library unavailable")
+        for mode in (0, 1):
+            for lower in (0, 1):
+                for dedup in (0, 1):
+                    for data in corpora:
+                        res = native.token_counts(data, mode, lower, dedup)
+                        buf = np.frombuffer(data, np.uint8)
+                        got = {}
+                        for i in range(len(res[0])):
+                            key = bytes(buf[res[3][i]:res[3][i] + res[4][i]])
+                            if lower:
+                                key = key.translate(fold_tbl)
+                            assert key not in got
+                            got[key] = int(res[2][i])
+                        want = collections.Counter()
+                        for line in data.split(b"\n"):
+                            if mode == 1:
+                                toks = re.split(
+                                    rb"[^0-9A-Za-z_\x80-\xff]+", line)
+                            else:
+                                toks = re.split(rb"[ \t\r\v\f]+", line)
+                            toks = [t for t in toks if t]
+                            if lower:
+                                toks = [t.translate(fold_tbl) for t in toks]
+                            want.update(set(toks) if dedup else toks)
+                        assert got == dict(want), (mode, lower, dedup)
+
 
 class TestDSLIntegration:
     def test_token_counts_pipeline_multi_chunk(self, tmp_path):
