@@ -52,6 +52,13 @@ class Streamable(object):
         raise NotImplementedError()
 
 
+def _identity(k, v):
+    """The no-op record map (checkpoint/sink stages with no queued aggs).
+    Lives here so the runner can recognize ``Map(_identity)`` stages and
+    pass whole blocks through instead of iterating records."""
+    yield k, v
+
+
 class Map(Mapper, Streamable):
     """Wraps a generator function ``f(k, v) -> iterable[(k, v)]``."""
 
@@ -107,6 +114,19 @@ def fuse(aggs):
     for i in range(2, len(aggs)):
         s = ComposedStreamable(s, aggs[i])
     return ComposedMapper(aggs[0], s)
+
+
+def is_pure_record_stream(m):
+    """True when a (possibly fused) mapper chains only plain ``Map`` steps,
+    so records transform independently and chunk granularity is mechanical.
+    False for anything carrying per-chunk semantics (StreamMapper observes
+    whole-partition iterators, BlockMapper has a per-chunk lifecycle) —
+    the runner's tiny-input collapse must not merge those chunks."""
+    if type(m) is Map:
+        return True
+    if type(m) in (ComposedMapper, ComposedStreamable):
+        return is_pure_record_stream(m.left) and is_pure_record_stream(m.right)
+    return False
 
 
 class BlockMapper(Mapper, Streamable):

@@ -28,7 +28,7 @@ import time
 from .base import (AssocFoldReducer, KeyedInnerJoin, KeyedLeftJoin,
                    KeyedOuterJoin, KeyedReduce, Map, MapAllJoin, MapCrossJoin,
                    Mapper, PartialReduceCombiner, Reducer, StreamMapper,
-                   StreamReducer, Streamable, fuse)
+                   StreamReducer, Streamable, _identity, fuse)
 from .dataset import CatDataset, Chunker
 from .graph import Graph, Source
 from .inputs import MemoryInput, PathInput, UrlsInput
@@ -61,8 +61,6 @@ class ValueEmitter(object):
         self.dataset.delete()
 
 
-def _identity(k, v):
-    yield k, v
 
 
 class PBase(object):

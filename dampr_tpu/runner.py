@@ -482,6 +482,23 @@ class MTRunner(object):
         chunks = self._as_chunks(entries[0])
         supplementary = [self._as_chunks(e) for e in entries[1:]]
 
+        # Tiny-input collapse: a small materialized input to a plain record
+        # mapper runs as ONE job over the concatenated refs instead of one
+        # job per ref — per-job fixed costs dominate at this size.  Only
+        # where chunking is mechanical, not semantic: pure record streams
+        # (fused chains of plain Maps — is_pure_record_stream walks the
+        # composition, since a fused chain can embed a StreamMapper whose
+        # per-chunk invocation IS the semantics) and the broadcast joins
+        # (which iterate the primary side record-wise).
+        if (len(chunks) > 1
+                and isinstance(entries[0], storage.PartitionSet)
+                and (base.is_pure_record_stream(stage.mapper)
+                     or type(stage.mapper) in (base.MapCrossJoin,
+                                               base.MapAllJoin))):
+            refs = list(entries[0].all_refs())
+            if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
+                chunks = [BlockDataset(refs)]
+
         combine_op = None
         if isinstance(stage.combiner, base.PartialReduceCombiner):
             combine_op = stage.combiner.op
@@ -508,7 +525,14 @@ class MTRunner(object):
             use_blocks = (not supplementary
                           and hasattr(mapper, "map_blocks")
                           and hasattr(chunk, "read_bytes"))
-            if use_blocks:
+            # Identity stages (bare checkpoint/sink heads) pass blocks
+            # through whole — the records are already materialized; walking
+            # them one by one through Python buys nothing.
+            ident_blocks = (not supplementary and not use_blocks
+                            and type(mapper) is base.Map
+                            and mapper.mapper is base._identity
+                            and hasattr(chunk, "iter_blocks"))
+            if use_blocks or ident_blocks:
                 kvs = None
             elif supplementary:
                 kvs = mapper.map(chunk, *supplementary)
@@ -530,6 +554,9 @@ class MTRunner(object):
 
             if use_blocks:
                 for blk in mapper.map_blocks(chunk):
+                    take(blk)
+            elif ident_blocks:
+                for blk in chunk.iter_blocks():
                     take(blk)
             else:
                 for k, v in kvs:
@@ -806,23 +833,8 @@ class MTRunner(object):
         assert bool(np.all(kt["u"][idx] == fu)), "mesh fold lost a key"
         out_keys = kt["k"].take(idx)
 
-        P = self.n_partitions
         pin = bool(stage.options.get("memory"))
-        n = len(fu)
-        vcol = np.empty(n, dtype=object)
-        for i in range(n):
-            k = out_keys[i]
-            if isinstance(k, np.generic):
-                k = k.item()
-            v = fv[i]
-            vcol[i] = (k, v.item() if isinstance(v, np.generic) else v)
-        out_blk = Block(out_keys, vcol, fh1, fh2)
-
-        pset = storage.PartitionSet(P)
-        nrec = 0
-        for pid, sub in out_blk.split_by_partition(P).items():
-            nrec += len(sub)
-            pset.add(pid, self.store.register(sub, pin=pin))
+        pset, nrec = self._emit_keyed_fold(out_keys, fv, fh1, fh2, pin)
         self.mesh_folds += 1
         log.info("mesh fold: %d keys folded across %d devices",
                  nrec, len(jax.devices()))
@@ -898,6 +910,65 @@ class MTRunner(object):
             self.mesh_exchanges += 1
         return out_entries
 
+    def _tiny_assoc_reduce(self, stage, entries):
+        """Small-stage fast path for associative folds: fold EVERY partition
+        in one vectorized pass over the concatenated refs, then re-split by
+        the same hash % P.  Partition identity of each key is unchanged
+        (same hash, same P); only the per-partition numpy fixed costs —
+        which dominate when partitions hold a few hundred records — are
+        collapsed.  Output shape matches the per-partition reducer exactly:
+        (k, (k, acc)) records, unordered within a partition (the same
+        contract the mesh fold path already ships)."""
+        if len(entries) != 1 or not isinstance(stage.reducer,
+                                               base.AssocFoldReducer):
+            return None
+        refs = list(entries[0].all_refs())
+        P = self.n_partitions
+        pin = bool(stage.options.get("memory"))
+        if not refs:
+            return storage.PartitionSet(P), 0, 1
+        # The one-pass fold materializes every ref at once, so it must stay
+        # inside the streaming memory discipline, not just the tiny-stage
+        # cutoff.
+        limit = settings.small_stage_bytes
+        thr = settings.streaming_reduce_threshold
+        if thr is None:
+            thr = self.store.budget
+        if sum(r.nbytes for r in refs) > min(limit, thr):
+            return None
+        merged = Block.concat([r.get() for r in refs])
+        if not len(merged):
+            return storage.PartitionSet(P), 0, 1
+        folded = segment.fold_sorted(
+            segment.sort_and_group(merged), stage.reducer.op)
+        h1, h2 = folded.hashes()
+        pset, nrec = self._emit_keyed_fold(folded.keys, folded.values,
+                                           h1, h2, pin)
+        return pset, nrec, 1
+
+    def _emit_keyed_fold(self, keys, vals, h1, h2, pin):
+        """Register a keyed fold result as a stage-output PartitionSet in
+        the reduce-output contract: (k, (k, acc)) records (KeyedReduce
+        shape), np.generic values unwrapped to Python scalars, split by the
+        engine hash % P.  Shared by the mesh fold and tiny-fold fast paths
+        so the contract lives in exactly one place."""
+        P = self.n_partitions
+        n = len(keys)
+        vcol = np.empty(n, dtype=object)
+        for i in range(n):
+            k = keys[i]
+            if isinstance(k, np.generic):
+                k = k.item()
+            v = vals[i]
+            vcol[i] = (k, v.item() if isinstance(v, np.generic) else v)
+        out_blk = Block(keys, vcol, h1, h2)
+        pset = storage.PartitionSet(P)
+        nrec = 0
+        for pid, sub in out_blk.split_by_partition(P).items():
+            nrec += len(sub)
+            pset.add(pid, self.store.register(sub, pin=pin))
+        return pset, nrec
+
     def run_reduce(self, stage_id, stage, env):
         entries = [env[s] for s in stage.inputs]
         for e in entries:
@@ -905,6 +976,9 @@ class MTRunner(object):
                 "reduce inputs must be materialized partitions; the DSL "
                 "checkpoints before grouping")
         fast = self._mesh_reduce(stage, entries)
+        if fast is not None:
+            return fast
+        fast = self._tiny_assoc_reduce(stage, entries)
         if fast is not None:
             return fast
         exchanged = self._mesh_exchange_entries(entries)
@@ -1072,6 +1146,15 @@ class MTRunner(object):
     def run_sink(self, stage_id, stage, env):
         entries = [env[s] for s in stage.inputs]
         chunks = self._as_chunks(entries[0])
+        # Same tiny-input collapse as run_map: sink chunking (one part file
+        # per chunk) is mechanical, and the sinker is always a fused record
+        # stream (dampr.py sink()).
+        if (len(chunks) > 1
+                and isinstance(entries[0], storage.PartitionSet)
+                and type(stage.sinker) in (base.Map, base.ComposedMapper)):
+            refs = list(entries[0].all_refs())
+            if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
+                chunks = [BlockDataset(refs)]
         os.makedirs(stage.path, exist_ok=True)
 
         def job(args):

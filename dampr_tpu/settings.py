@@ -53,6 +53,15 @@ max_memory_per_stage = 512 * 1024 * 1024
 #: Mesh axis name used for data-parallel sharding of record batches.
 mesh_axis = "shards"
 
+#: Stages whose materialized input is at most this many bytes skip per-
+#: partition fan-out: plain record maps and sinks run as one job over the
+#: concatenated refs, and associative folds reduce every partition in one
+#: vectorized pass before re-splitting by hash.  Partition *identity* is
+#: unchanged (outputs re-split by the same hash % P), only job granularity
+#: collapses — per-partition numpy fixed costs dominate tiny stages
+#: (measured: 64 partitions x ~1 ms on a 24k-record fold).
+small_stage_bytes = 4 * 1024 * 1024
+
 #: When True, keyed kernels (hash/sort/segment-reduce) run through JAX on the default
 #: backend; when False everything uses the numpy host fallback (useful for debugging).
 use_device = os.environ.get("DAMPR_TPU_USE_DEVICE", "1") not in ("0", "false")

@@ -71,3 +71,49 @@ class TestFailFast:
 
         with pytest.raises(ValueError, match="reduce exploded"):
             Dampr.memory([1, 2, 3]).group_by(lambda x: 1).reduce(boom).read()
+
+
+class TestTinyStageCollapse:
+    """The tiny-input collapse must never change results — only job
+    granularity.  Chunk-semantic operators (partition_map's StreamMapper,
+    even fused inside a ComposedMapper chain) keep per-ref chunks."""
+
+    def _counts_per_chunk(self):
+        def per_chunk(it):
+            n = sum(1 for _ in it)
+            yield 1, n
+
+        return (Dampr.memory(list(range(2000)), partitions=8)
+                .checkpoint(force=True)
+                .partition_map(per_chunk)
+                .map(lambda x: x))
+
+    def test_partition_map_fused_chain_not_collapsed(self):
+        from dampr_tpu import settings
+        old = settings.small_stage_bytes
+        try:
+            settings.small_stage_bytes = 0  # collapse off: ground truth
+            want = sorted(self._counts_per_chunk().read())
+            settings.small_stage_bytes = old  # collapse on (default 4MB)
+            got = sorted(self._counts_per_chunk().read())
+        finally:
+            settings.small_stage_bytes = old
+        assert got == want
+        assert len(got) > 1  # genuinely per-chunk, not one merged call
+
+    def test_assoc_fold_same_result_with_and_without_collapse(self):
+        from dampr_tpu import settings
+
+        def pipe():
+            return (Dampr.memory(list(range(300)) * 5, partitions=16)
+                    .count(lambda x: x % 97))
+
+        old = settings.small_stage_bytes
+        try:
+            settings.small_stage_bytes = 0
+            want = sorted(v for _k, v in pipe().read())
+            settings.small_stage_bytes = old
+            got = sorted(v for _k, v in pipe().read())
+        finally:
+            settings.small_stage_bytes = old
+        assert got == want
