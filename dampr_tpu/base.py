@@ -41,6 +41,12 @@ class Splitter(object):
 class Mapper(object):
     """Lowest-level map interface: consume whole datasets, yield (k, v)."""
 
+    #: Declares that map_blocks prefers the bounded iter_byte_blocks scan
+    #: over materializing chunk bytes.  The runner's scan-sharing pass runs
+    #: byte-materializing members first so streaming members can serve from
+    #: the already-read bytes.
+    streams_bytes = False
+
     def map(self, *datasets):
         raise NotImplementedError()
 

@@ -249,6 +249,8 @@ class CountRecords(Mapper):
     final line), no per-line Python.  Emits the same ``(1, count)`` record
     the DSL's generic ``len()`` map emits (reference dampr.py:254-259)."""
 
+    streams_bytes = True  # prefers the bounded iter_byte_blocks scan
+
     def map_blocks(self, dataset):
         from ..blocks import Block
 

@@ -83,6 +83,33 @@ def _exchange_mesh_gate(budget):
     return mesh, D, window
 
 
+class _SharedScanChunk(object):
+    """One-read view of a tap chunk shared by scan-fused map stages: the
+    first read_bytes() materializes, later readers (including streaming
+    iter_byte_blocks consumers) serve from the cache.  If nothing ever
+    materializes, iter_byte_blocks delegates to the chunk's own bounded
+    scan — fusion never raises the memory ceiling above what the widest
+    member would have used alone."""
+
+    def __init__(self, chunk):
+        self._chunk = chunk
+        self._bytes = None
+
+    def read_bytes(self):
+        if self._bytes is None:
+            self._bytes = self._chunk.read_bytes()
+        return self._bytes
+
+    def __getattr__(self, name):
+        if name == "iter_byte_blocks":
+            if self._bytes is not None:
+                cached = self._bytes
+                # accept (and ignore) block_size etc. like the real method
+                return lambda *a, **k: iter((cached,))
+            return getattr(self._chunk, name)  # AttributeError if absent
+        return getattr(self._chunk, name)
+
+
 class _RawRef(object):
     """Minimal in-memory stand-in for BlockRef when an OutputDataset has no
     store (direct construction in tests/tools)."""
@@ -499,6 +526,85 @@ class MTRunner(object):
             if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
                 chunks = [BlockDataset(refs)]
 
+        job, combine_op, pin, feeds_reduce = self._map_job_factory(
+            stage, supplementary)
+
+        n_maps = stage.options.get("n_maps", self.n_maps)
+        results = self._pool_run(job, chunks, n_maps)
+        pset = self._collect_partitions(results, combine_op, pin,
+                                        feeds_reduce)
+        return pset, pset.total_records(), len(chunks)
+
+    def _collect_partitions(self, mappings, combine_op, pin, feeds_reduce):
+        """Assemble per-chunk {pid: [refs]} job results into one compacted
+        PartitionSet (shared by run_map and run_map_group)."""
+        pset = storage.PartitionSet(self.n_partitions)
+        for mapping in mappings:
+            for pid, refs in mapping.items():
+                for ref in refs:
+                    pset.add(pid, ref)
+        self._compact_partitions(pset, combine_op, pin, feeds_reduce)
+        return pset
+
+    def _scan_share_group(self, sid, stage, env):
+        """Later GMap stages reading the SAME tap source as `stage`: fusion
+        candidates for one shared pass.  Only single-input stages over a
+        Chunker tap (where IO is the dominant cost) qualify."""
+        if not settings.scan_sharing or len(stage.inputs) != 1:
+            return []
+        if not isinstance(env.get(stage.inputs[0]), Chunker):
+            return []
+        group = []
+        for sjd in range(sid + 1, len(self.graph.stages)):
+            s2 = self.graph.stages[sjd]
+            if (isinstance(s2, GMap) and len(s2.inputs) == 1
+                    and s2.inputs[0] == stage.inputs[0]):
+                group.append((sjd, s2))
+        return group
+
+    def run_map_group(self, sids, stages, env):
+        """Scan sharing: execute several map stages over one pass of their
+        common tap — block-path members (read_bytes / iter_byte_blocks)
+        share one chunk read via the _SharedScanChunk cache; per-record
+        members read independently.  Byte-materializing members run
+        before streaming ones (Mapper.streams_bytes) so the streamers reuse
+        the already-read bytes; if no member materializes, streamers stream
+        exactly as they would alone (no new memory ceiling).  Returns one
+        (pset, nrec, njobs) per stage, in the given order."""
+        tap = env[stages[0].inputs[0]]
+        chunks = self._as_chunks(tap)
+        factories = [self._map_job_factory(s, []) for s in stages]
+        order = sorted(range(len(stages)),
+                       key=lambda i: bool(
+                           getattr(stages[i].mapper, "streams_bytes", False)))
+
+        def group_job(chunk):
+            shared = (_SharedScanChunk(chunk)
+                      if hasattr(chunk, "read_bytes") else chunk)
+            outs = [None] * len(stages)
+            for i in order:
+                outs[i] = factories[i][0](shared)
+            return outs
+
+        # Honor every member's explicit n_maps: the most restrictive wins,
+        # so a stage that asked to serialize stays serialized when fused.
+        n_maps = min(s.options.get("n_maps", self.n_maps) for s in stages)
+        results = self._pool_run(group_job, chunks, n_maps)
+
+        ret = []
+        for i in range(len(stages)):
+            _job, combine_op, pin, feeds_reduce = factories[i]
+            pset = self._collect_partitions(
+                [outs[i] for outs in results], combine_op, pin, feeds_reduce)
+            ret.append((pset, pset.total_records(), len(chunks)))
+        log.info("scan sharing: %d stages fused over one pass of %d chunks",
+                 len(stages), len(chunks))
+        return ret
+
+    def _map_job_factory(self, stage, supplementary):
+        """Build the per-chunk job closure for one map stage.  Shared by
+        run_map and the scan-sharing group executor (run_map_group), which
+        runs several stages' jobs over one chunk read."""
         combine_op = None
         if isinstance(stage.combiner, base.PartialReduceCombiner):
             combine_op = stage.combiner.op
@@ -581,16 +687,7 @@ class MTRunner(object):
                         self.store.register(sub, pin=pin))
             return out
 
-        n_maps = stage.options.get("n_maps", self.n_maps)
-        results = self._pool_run(job, chunks, n_maps)
-
-        pset = storage.PartitionSet(P)
-        for mapping in results:
-            for pid, refs in mapping.items():
-                for ref in refs:
-                    pset.add(pid, ref)
-        self._compact_partitions(pset, combine_op, pin, feeds_reduce)
-        return pset, pset.total_records(), len(chunks)
+        return job, combine_op, pin, feeds_reduce
 
     def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True):
         """Block-count governor (the reference's file-count combiner rounds,
@@ -1186,6 +1283,7 @@ class MTRunner(object):
     def _run(self, outputs, cleanup=True):
         env = {}
         to_delete = []
+        fused = {}  # sid -> (pset, nrec, njobs) computed by an earlier pass
         n_stages = len(self.graph.stages)
         for sid, stage in enumerate(self.graph.stages):
             t0 = time.time()
@@ -1196,7 +1294,20 @@ class MTRunner(object):
 
             log.info("Stage %s/%s: %r", sid + 1, n_stages, stage)
             if isinstance(stage, GMap):
-                result, nrec, njobs = self.run_map(sid, stage, env)
+                if sid in fused:
+                    result, nrec, njobs = fused.pop(sid)
+                else:
+                    group = self._scan_share_group(sid, stage, env)
+                    if group:
+                        members = [(sid, stage)] + group
+                        outs = self.run_map_group(
+                            [s for s, _ in members],
+                            [st for _, st in members], env)
+                        for (msid, _), out in zip(members[1:], outs[1:]):
+                            fused[msid] = out
+                        result, nrec, njobs = outs[0]
+                    else:
+                        result, nrec, njobs = self.run_map(sid, stage, env)
                 kind = "map"
                 to_delete.append(stage.output)
             elif isinstance(stage, GReduce):

@@ -62,6 +62,15 @@ mesh_axis = "shards"
 #: (measured: 64 partitions x ~1 ms on a 24k-record fold).
 small_stage_bytes = 4 * 1024 * 1024
 
+#: Scan sharing: map stages that read the SAME input tap (shared pipeline
+#: prefixes — word_stats' four branches, TF-IDF's doc-freq + len) execute
+#: fused in one pass over the chunks.  Members on the vectorized block
+#: path (read_bytes / iter_byte_blocks) are served from one shared read
+#: per chunk; per-record members still read their input independently.
+#: Purely a scheduling change — per-stage outputs, partitioning, and
+#: cleanup are unchanged.
+scan_sharing = True
+
 #: When True, keyed kernels (hash/sort/segment-reduce) run through JAX on the default
 #: backend; when False everything uses the numpy host fallback (useful for debugging).
 use_device = os.environ.get("DAMPR_TPU_USE_DEVICE", "1") not in ("0", "false")

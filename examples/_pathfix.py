@@ -1,0 +1,10 @@
+"""Make `python examples/<script>.py` work without installing the package:
+Python puts the script's own directory (examples/) on sys.path, so the repo
+root — where the dampr_tpu package lives — is inserted here once."""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)

@@ -11,6 +11,9 @@ Two layers demonstrate the same decomposition:
 Usage: python examples/sgd.py [n_samples] [n_features] [steps]
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
+import os
 import sys
 
 import numpy as np
@@ -18,6 +21,20 @@ import numpy as np
 from dampr_tpu import Dampr, setup_logging
 from dampr_tpu.parallel import sgd
 from dampr_tpu.parallel.mesh import data_mesh
+
+
+def _honor_cpu_request():
+    """The environment's TPU plugin can programmatically override
+    jax_platforms at interpreter start, clobbering JAX_PLATFORMS=cpu — which
+    would point the mesh route at a (possibly unreachable) remote tunnel.
+    Re-assert a CPU request the way the plugin can't override."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized: keep whatever it is
 
 
 def dsl_gradient(pipe, w, b):
@@ -71,5 +88,6 @@ def main(n=4096, f=64, steps=10):
 
 if __name__ == "__main__":
     setup_logging()
+    _honor_cpu_request()
     args = [int(a) for a in sys.argv[1:4]]
     main(*args)

@@ -9,6 +9,8 @@ as an example, in both styles:
 Usage: python examples/tf_idf.py <file-or-dir> [--parity]
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import math
 import multiprocessing
 import operator

@@ -6,6 +6,8 @@ hash + device segment-sum with map-side combining before the shuffle.
 Usage: python examples/wc.py <file-or-dir> [chunk_size_mb]
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import sys
 
 from dampr_tpu import Dampr, setup_logging

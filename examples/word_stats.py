@@ -5,6 +5,8 @@ unioned into a single run, so the shared stages compute once.
 Usage: python examples/word_stats.py <file-or-dir>
 """
 
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
 import sys
 
 from dampr_tpu import Dampr, setup_logging
