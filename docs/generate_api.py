@@ -8,6 +8,11 @@ Run: python docs/generate_api.py
 import importlib
 import inspect
 import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python docs/generate_api.py` from any cwd
+    sys.path.insert(0, _REPO_ROOT)
 
 MODULES = [
     "dampr_tpu",
