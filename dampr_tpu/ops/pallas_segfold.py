@@ -49,15 +49,38 @@ def _build_kernel():
     from jax import lax
     from jax.experimental import pallas as pl
 
+    def corner(x, r, l):
+        """Scalar at static position (r, l) of a tile.  jnp integer
+        indexing (``x[-1, -1]``) lowers through ``dynamic_slice`` even for
+        constant indices, which this Mosaic version does not implement;
+        a static ``lax.slice`` + single-element reduce does."""
+        r = r % x.shape[0]
+        l = l % x.shape[1]
+        assert jnp.issubdtype(x.dtype, jnp.signedinteger), (
+            "corner() requires signed tiles: Mosaic lacks unsigned "
+            "reductions (callers convert hash/validity lanes to int32)")
+        return jnp.sum(lax.slice(x, (r, l), (r + 1, l + 1)))
+
+    def _iotas(shape):
+        ri = lax.broadcasted_iota(jnp.int32, shape, 0)
+        li = lax.broadcasted_iota(jnp.int32, shape, 1)
+        return ri, li
+
     def shift_one(x, first):
         """Flattened-order shift-by-one of an (R, L) tile: element (r, l)
         receives (r, l-1), row starts receive the previous row's last lane,
-        and (0, 0) receives ``first`` (the carried previous element)."""
-        lanes = jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
-        prev_row_last = jnp.concatenate(
-            [jnp.full((1, 1), first, x.dtype), x[:-1, -1:]], axis=0)
-        col0 = prev_row_last
-        return jnp.concatenate([col0, lanes[:, 1:]], axis=1)
+        and (0, 0) receives ``first`` (the carried previous element).
+
+        Built from ``pltpu.roll`` + iota masks: Mosaic rejects the natural
+        width-1 column concatenates ("offset mismatch on non-concat
+        dimension"), but full-tile rotates lower cleanly."""
+        from jax.experimental.pallas import tpu as pltpu
+
+        lane = pltpu.roll(x, 1, axis=1)       # (r, l) <- (r, (l-1) % L)
+        wrap = pltpu.roll(lane, 1, axis=0)    # at l==0: (r, 0) <- (r-1, L-1)
+        ri, li = _iotas(x.shape)
+        s = jnp.where(li == 0, wrap, lane)
+        return jnp.where((li == 0) & (ri == 0), first, s)
 
     def _scan(x, op, pad, axis):
         """Inclusive Hillis-Steele scan along one axis of a 2-D tile.
@@ -116,10 +139,13 @@ def _build_kernel():
             carry_ref[3] = jnp.int32(0)
             carry_ref[4] = jnp.int32(0)
 
-        h1 = h1_ref[:]
-        h2 = h2_ref[:]
+        # All key/validity logic runs in int32 bitspace (same-width integer
+        # conversion is modular, so equality is preserved): Mosaic lacks
+        # unsigned reductions and some unsigned selects.
+        h1 = h1_ref[:].astype(jnp.int32)
+        h2 = h2_ref[:].astype(jnp.int32)
         v = v_ref[:]
-        inv = inv_ref[:]
+        inv = inv_ref[:].astype(jnp.int32)
 
         ph1 = shift_one(h1, carry_ref[0].astype(h1.dtype))
         ph2 = shift_one(h2, carry_ref[1].astype(h2.dtype))
@@ -144,9 +170,12 @@ def _build_kernel():
         # the forced "next" must differ from the LAST element so the
         # array's final record is always an end; +1 wraps and so always
         # differs in the h1 lane
-        nxt_h1 = jnp.where(i == last, h1[-1, -1] + 1, nh1_ref[0, 0])
-        nxt_h2 = jnp.where(i == last, h2[-1, -1], nh2_ref[0, 0])
-        nxt_inv = jnp.where(i == last, jnp.uint32(3), ninv_ref[0, 0])
+        nxt_h1 = jnp.where(i == last, corner(h1, -1, -1) + 1,
+                           corner(nh1_ref[:].astype(jnp.int32), 0, 0))
+        nxt_h2 = jnp.where(i == last, corner(h2, -1, -1),
+                           corner(nh2_ref[:].astype(jnp.int32), 0, 0))
+        nxt_inv = jnp.where(i == last, jnp.int32(3),
+                            corner(ninv_ref[:].astype(jnp.int32), 0, 0))
         nh1s = shift_back(h1, nxt_h1)
         nh2s = shift_back(h2, nxt_h2)
         ninvs = shift_back(inv, nxt_inv)
@@ -155,25 +184,28 @@ def _build_kernel():
         tot_ref[:] = jnp.where(ends, prefix - run_start_ex, 0).astype(
             tot_ref.dtype)
         live_ref[:] = jnp.where(
-            ends & (inv == 0), jnp.uint32(1), jnp.uint32(0))
+            ends & (inv == 0), 1, 0).astype(live_ref.dtype)
 
         # Update carries for the next tile.
-        carry_ref[0] = h1[-1, -1].astype(jnp.int32)
-        carry_ref[1] = h2[-1, -1].astype(jnp.int32)
-        carry_ref[2] = inv[-1, -1].astype(jnp.int32)
-        carry_ref[3] = prefix[-1, -1]
-        carry_ref[4] = run_start_ex[-1, -1]
+        carry_ref[0] = corner(h1, -1, -1).astype(jnp.int32)
+        carry_ref[1] = corner(h2, -1, -1).astype(jnp.int32)
+        carry_ref[2] = corner(inv, -1, -1).astype(jnp.int32)
+        carry_ref[3] = corner(prefix, -1, -1)
+        carry_ref[4] = corner(run_start_ex, -1, -1)
 
     def shift_back(x, nxt):
         """Flattened-order shift-backward-by-one: element (r, l) receives
         (r, l+1); row ends receive the next row's first lane; the tile's
-        last element receives ``nxt``."""
-        import jax.numpy as jnp
+        last element receives ``nxt``.  Same roll+mask construction as
+        :func:`shift_one` (rolls take non-negative shifts: size-1 = -1)."""
+        from jax.experimental.pallas import tpu as pltpu
 
-        lanes = jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)
-        next_row_first = jnp.concatenate(
-            [x[1:, :1], jnp.full((1, 1), nxt, x.dtype)], axis=0)
-        return jnp.concatenate([lanes[:, :-1], next_row_first], axis=1)
+        R, L = x.shape
+        lane = pltpu.roll(x, L - 1, axis=1)   # (r, l) <- (r, (l+1) % L)
+        wrap = pltpu.roll(lane, R - 1, axis=0)  # at l==L-1: <- (r+1, 0)
+        ri, li = _iotas(x.shape)
+        s = jnp.where(li == L - 1, wrap, lane)
+        return jnp.where((li == L - 1) & (ri == R - 1), nxt, s)
 
     return kernel
 
@@ -192,9 +224,12 @@ def _segfold_call(n_tiles, interpret):
         return (i, 0)
 
     def next_tile_map(i):
-        # lookahead view: one tile ahead, clamped on the final tile (its
-        # values are ignored there — the kernel forces a difference)
-        return (jnp.minimum(i + 1, n_tiles - 1), 0)
+        # lookahead view: the first sublane-aligned row block of the next
+        # tile (only its [0, 0] element is read), clamped on the final tile
+        # (its values are ignored there — the kernel forces a difference).
+        # Index units are (8, L) blocks: one tile spans R // 8 of them.
+        per_tile = R // 8
+        return (jnp.minimum((i + 1) * per_tile, n_tiles * per_tile - 1), 0)
 
     def call(h1, h2, v, inv):
         return pl.pallas_call(
@@ -205,9 +240,9 @@ def _segfold_call(n_tiles, interpret):
                 pl.BlockSpec((R, L), tile_map),
                 pl.BlockSpec((R, L), tile_map),
                 pl.BlockSpec((R, L), tile_map),
-                pl.BlockSpec((R, L), next_tile_map),
-                pl.BlockSpec((R, L), next_tile_map),
-                pl.BlockSpec((R, L), next_tile_map),
+                pl.BlockSpec((8, L), next_tile_map),
+                pl.BlockSpec((8, L), next_tile_map),
+                pl.BlockSpec((8, L), next_tile_map),
             ],
             out_specs=[
                 pl.BlockSpec((R, L), tile_map),
