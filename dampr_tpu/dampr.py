@@ -71,7 +71,18 @@ class PBase(object):
 
     def run(self, name=None, **kwargs):
         """Evaluate the composed graph; returns a ValueEmitter (its ``stats``
-        attribute carries per-stage timing/record counters)."""
+        attribute carries per-stage timing/record counters).
+
+        ``resume=True`` makes the run durable: each completed stage
+        checkpoints its output under the run's scratch root, and a rerun
+        with the SAME ``name`` skips every stage whose checkpoint is still
+        valid (see :mod:`dampr_tpu.resume`).  Requires an explicit name —
+        an auto-generated one can never match a previous run.
+        """
+        if kwargs.get("resume") and name is None:
+            raise ValueError(
+                "resume=True requires a stable run name: run(name=..., "
+                "resume=True)")
         if name is None:
             name = "dampr/{}".format(random.random())
         runner = self.pmer.runner(name, self.pmer.graph, **kwargs)
@@ -559,6 +570,10 @@ class Dampr(object):
             graph = pmer.pmer.graph if i == 0 else pmer.pmer.graph.union(graph)
             sources.append(pmer.source)
 
+        if kwargs.get("resume") and kwargs.get("name") is None:
+            raise ValueError(
+                "resume=True requires a stable run name: Dampr.run(..., "
+                "name=..., resume=True)")
         name = kwargs.pop("name", "dampr/{}".format(random.random()))
         runner = pmer.pmer.runner(name, graph, **kwargs)
         ds = runner.run(sources)

@@ -450,9 +450,10 @@ class MTRunner(object):
     (reference MTRunner, runner.py:235-374)."""
 
     def __init__(self, name, graph, n_maps=None, n_reducers=None,
-                 n_partitions=None, memory_budget=None):
+                 n_partitions=None, memory_budget=None, resume=False):
         self.name = name
         self.graph = graph
+        self.resume = bool(resume)
         self.n_maps = n_maps or settings.max_processes
         self.n_reducers = n_reducers or settings.max_processes
         self.n_partitions = n_partitions or settings.partitions
@@ -1284,7 +1285,35 @@ class MTRunner(object):
         env = {}
         to_delete = []
         fused = {}  # sid -> (pset, nrec, njobs) computed by an earlier pass
+        plan, stage_fps = {}, {}
         n_stages = len(self.graph.stages)
+        required = None  # None = every stage (the non-resume fast path)
+        from . import resume as _resume
+
+        if self.resume:
+            stage_fps = _resume.stage_fingerprints(self.graph)
+            plan = _resume.load_plan(self.store.root, stage_fps)
+            if plan:
+                log.info("resume: %d stage(s) restorable from %s",
+                         len(plan), self.store.root)
+            # Lazy need-set: a stage executes only if its output feeds a
+            # stage that executes (or is itself requested / an effectful
+            # sink) AND it was not restored.  Without this, a rerun whose
+            # intermediates were cleaned up would recompute the whole chain
+            # below its one surviving (final-output) checkpoint.
+            required = set()
+            needed = set(outputs)
+            for sid in range(n_stages - 1, -1, -1):
+                stage = self.graph.stages[sid]
+                if isinstance(stage, GInput):
+                    continue
+                if stage.output not in needed and not isinstance(
+                        stage, GSink):
+                    continue
+                required.add(sid)
+                if sid in plan:
+                    continue  # restored from checkpoint: inputs not needed
+                needed.update(stage.inputs)
         for sid, stage in enumerate(self.graph.stages):
             t0 = time.time()
             self.store.set_stage(sid)
@@ -1292,12 +1321,34 @@ class MTRunner(object):
                 env[stage.output] = stage.tap
                 continue
 
+            if required is not None and sid not in required:
+                log.info("Stage %s/%s skipped: every consumer was restored "
+                         "from checkpoint", sid + 1, n_stages)
+                continue
             log.info("Stage %s/%s: %r", sid + 1, n_stages, stage)
+            if sid in plan:
+                result, nrec = _resume.restore_stage(
+                    self.store.root, plan[sid])
+                env[stage.output] = result
+                if not isinstance(stage, GSink):
+                    to_delete.append(stage.output)
+                st = StageStats(sid, "resumed-" + (
+                    "map" if isinstance(stage, GMap) else
+                    "reduce" if isinstance(stage, GReduce) else "sink"))
+                st.n_jobs = 0
+                st.records_out = nrec
+                st.seconds = time.time() - t0
+                self.stats.append(st)
+                log.info("Stage %s resumed: %s", sid + 1, st.as_dict())
+                continue
             if isinstance(stage, GMap):
                 if sid in fused:
                     result, nrec, njobs = fused.pop(sid)
                 else:
-                    group = self._scan_share_group(sid, stage, env)
+                    group = [g for g in self._scan_share_group(
+                        sid, stage, env)
+                        if g[0] not in plan
+                        and (required is None or g[0] in required)]
                     if group:
                         members = [(sid, stage)] + group
                         outs = self.run_map_group(
@@ -1321,6 +1372,9 @@ class MTRunner(object):
                 raise TypeError("Unknown stage type: {!r}".format(stage))
 
             env[stage.output] = result
+            if self.resume:
+                _resume.persist_stage(
+                    self.store, sid, stage_fps[sid], result, nrec)
             st = StageStats(sid, kind)
             st.n_jobs = njobs
             st.records_out = nrec
@@ -1348,6 +1402,12 @@ class MTRunner(object):
                     continue
                 entry = env.get(source)
                 if isinstance(entry, storage.PartitionSet):
-                    entry.delete(self.store)
+                    if self.resume:
+                        # Durable runs keep intermediate checkpoints on disk
+                        # (a modified rerun resumes from the longest valid
+                        # prefix) but release RAM residency now.
+                        entry.release(self.store)
+                    else:
+                        entry.delete(self.store)
 
         return ret

@@ -52,6 +52,24 @@ class BlockRef(object):
             self._block = block
             self.nbytes = block.nbytes()
 
+    @classmethod
+    def from_disk(cls, path, nrecords, nbytes, key_dtype, value_dtype):
+        """Rebuild a disk-backed ref from checkpoint-manifest metadata
+        (resume.py): no RAM residency, reads stream from ``path``."""
+        import numpy as np
+
+        ref = cls.__new__(cls)
+        ref._block = None
+        ref._packed = None
+        ref.path = path
+        ref.nrecords = nrecords
+        ref.nbytes = nbytes
+        ref.key_dtype = np.dtype(key_dtype)
+        ref.value_dtype = np.dtype(value_dtype)
+        ref.store = None
+        ref.pin = False
+        return ref
+
     def __len__(self):
         return self.nrecords
 
@@ -92,9 +110,12 @@ class BlockRef(object):
     def spill(self, directory):
         if self._block is None or self.pin:
             return 0
-        os.makedirs(directory, exist_ok=True)
-        self.path = os.path.join(directory, uuid.uuid4().hex + ".blk")
-        save_block(self._block, self.path)
+        if self.path is None:
+            os.makedirs(directory, exist_ok=True)
+            self.path = os.path.join(directory, uuid.uuid4().hex + ".blk")
+            save_block(self._block, self.path)
+        # else: already durable on disk (checkpoint/resume persisted it) —
+        # dropping the RAM copy is the whole spill.
         freed = self.nbytes
         self._block = None
         return freed
@@ -284,6 +305,18 @@ class RunStore(object):
                 self._resident_bytes -= ref.nbytes
         ref.delete()
 
+    def release_ref(self, ref):
+        """Free a ref's RAM residency but KEEP its on-disk file (durable
+        checkpoint): the budget no longer charges it, reads stream from
+        disk.  Refs that never got a path keep their RAM block (nothing
+        else holds the data)."""
+        with self._lock:
+            if ref in self._resident:
+                self._resident.remove(ref)
+                self._resident_bytes -= ref.nbytes
+        if ref.path is not None:
+            ref._block = None
+
     def cleanup(self):
         """Remove the run's scratch tree (outputs the caller wants to keep
         must have been read or re-registered elsewhere first)."""
@@ -324,3 +357,9 @@ class PartitionSet(object):
                 else:
                     ref.delete()
         self.parts = {}
+
+    def release(self, store):
+        """Free RAM residency, keep disk files (checkpoint retention)."""
+        for refs in self.parts.values():
+            for ref in refs:
+                store.release_ref(ref)
