@@ -216,15 +216,20 @@ def _fp_tap(tap):
 
 # -- per-stage chained fingerprints ------------------------------------------
 
-def stage_fingerprints(graph):
-    """{sid: chained fp} for every non-input stage, in schedule order."""
+def stage_fingerprints(graph, salt=""):
+    """{sid: chained fp} for every non-input stage, in schedule order.
+
+    ``salt`` carries engine configuration that shapes stage OUTPUT layout
+    (the partition count: restored partition sets must co-partition with
+    re-executed join sides), so a config change invalidates checkpoints.
+    """
     from .graph import GInput, GMap, GReduce, GSink
 
     src_fp = {}
     out = {}
     for sid, stage in enumerate(graph.stages):
         if isinstance(stage, GInput):
-            src_fp[stage.output] = _fp_tap(stage.tap)
+            src_fp[stage.output] = _h("tap-salted", salt, _fp_tap(stage.tap))
             continue
         inputs = tuple(src_fp.get(s, "missing") for s in stage.inputs)
         if isinstance(stage, GMap):

@@ -1291,7 +1291,8 @@ class MTRunner(object):
         from . import resume as _resume
 
         if self.resume:
-            stage_fps = _resume.stage_fingerprints(self.graph)
+            stage_fps = _resume.stage_fingerprints(
+                self.graph, salt="p{}".format(self.n_partitions))
             plan = _resume.load_plan(self.store.root, stage_fps)
             if plan:
                 log.info("resume: %d stage(s) restorable from %s",

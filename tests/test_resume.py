@@ -285,6 +285,29 @@ class TestResume:
                              memory_budget=1 << 14).stream())
         assert b == a
 
+    def test_partition_count_change_invalidates(self, workdir):
+        # A restored partition set must co-partition with anything computed
+        # fresh: changing n_partitions invalidates prior checkpoints.
+        name = "resume-parts"
+        _fresh(name)
+
+        def build():
+            left = Dampr.memory(
+                [("k%d" % (i % 4), i) for i in range(20)],
+                partitions=3).group_by(lambda x: x[0])
+            right = Dampr.memory(
+                [("k%d" % (i % 4), 100 + i) for i in range(8)],
+                partitions=2).group_by(lambda x: x[0])
+            return left.join(right).reduce(
+                lambda lit, rit: (len(list(lit)), len(list(rit))))
+
+        a = dict(build().run(name=name, resume=True,
+                             n_partitions=4).stream())
+        b = dict(build().run(name=name, resume=True,
+                             n_partitions=7).stream())
+        want = {"k%d" % k: (5, 2) for k in range(4)}
+        assert a == want and b == want
+
     def test_resume_off_is_default_and_untouched(self, workdir):
         name = "resume-off"
         _fresh(name)
