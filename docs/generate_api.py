@@ -24,6 +24,7 @@ MODULES = [
     "dampr_tpu.graph",
     "dampr_tpu.runner",
     "dampr_tpu.storage",
+    "dampr_tpu.resume",
     "dampr_tpu.settings",
     "dampr_tpu.ops.hashing",
     "dampr_tpu.ops.segment",
