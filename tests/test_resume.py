@@ -308,6 +308,38 @@ class TestResume:
         want = {"k%d" % k: (5, 2) for k in range(4)}
         assert a == want and b == want
 
+    def test_resume_with_scan_shared_branches(self, workdir):
+        # Two branches over one text tap fuse into a scan-share group; both
+        # persist, and a rerun restores both without re-reading the tap.
+        name = "resume-scanshare"
+        _fresh(name)
+        path = os.path.join(workdir, "data.txt")
+        with open(path, "w") as f:
+            for i in range(50):
+                f.write("a b c\n" if i % 2 else "a\n")
+
+        def build():
+            docs = Dampr.text(path)
+            wc = (docs.flat_map(lambda line: line.split())
+                  .fold_by(lambda t: t, value=lambda t: 1,
+                           binop=lambda a, b: a + b))
+            nlines = docs.len()
+            return wc, nlines
+
+        w1, n1 = build()
+        r1 = Dampr.run(w1, n1, name=name, resume=True)
+        want_wc = dict(r1[0].stream())
+        want_n = list(r1[1].stream())
+        assert want_wc == {"a": 50, "b": 25, "c": 25}
+        assert want_n == [50]
+
+        w2, n2 = build()
+        r2 = Dampr.run(w2, n2, name=name, resume=True)
+        assert dict(r2[0].stream()) == want_wc
+        assert list(r2[1].stream()) == want_n
+        assert all(s["kind"].startswith("resumed-") or s["n_jobs"] == 0
+                   for s in r2[0].stats)
+
     def test_resume_off_is_default_and_untouched(self, workdir):
         name = "resume-off"
         _fresh(name)
