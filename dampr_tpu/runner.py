@@ -527,7 +527,7 @@ class MTRunner(object):
             if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
                 chunks = [BlockDataset(refs)]
 
-        job, combine_op, pin, feeds_reduce = self._map_job_factory(
+        job, combine_op, pin, feeds_reduce, _new_sink = self._map_job_factory(
             stage, supplementary)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
@@ -565,21 +565,48 @@ class MTRunner(object):
 
     def run_map_group(self, sids, stages, env):
         """Scan sharing: execute several map stages over one pass of their
-        common tap — block-path members (read_bytes / iter_byte_blocks)
-        share one chunk read via the _SharedScanChunk cache; per-record
-        members read independently.  Byte-materializing members run
-        before streaming ones (Mapper.streams_bytes) so the streamers reuse
-        the already-read bytes; if no member materializes, streamers stream
-        exactly as they would alone (no new memory ceiling).  Returns one
-        (pset, nrec, njobs) per stage, in the given order."""
+        common tap.
+
+        Preferred path — every member exposes ``window_sink`` (the
+        ops.text scanners): ONE line-aligned window pass per chunk fans
+        each window out to every member's sink and pushes the resulting
+        blocks straight into that member's fold/register pipeline, so the
+        tap is read (and a .gz decompressed) exactly once with memory
+        bounded by the window, never the chunk.
+
+        Fallback — members that materialize bytes share one chunk read via
+        the _SharedScanChunk cache (byte-materializing members run before
+        streaming ones, Mapper.streams_bytes); per-record members read
+        independently.  Returns one (pset, nrec, njobs) per stage, in the
+        given order."""
         tap = env[stages[0].inputs[0]]
         chunks = self._as_chunks(tap)
         factories = [self._map_job_factory(s, []) for s in stages]
         order = sorted(range(len(stages)),
                        key=lambda i: bool(
                            getattr(stages[i].mapper, "streams_bytes", False)))
+        all_window = all(
+            hasattr(s.mapper, "window_sink") for s in stages)
 
         def group_job(chunk):
+            if all_window and hasattr(chunk, "iter_byte_blocks"):
+                from .ops.text import _scan_windows
+
+                members = []
+                for i, s in enumerate(stages):
+                    push, end = factories[i][4]()
+                    members.append(
+                        (_clone_op(s.mapper).window_sink(), push, end))
+                for win in _scan_windows(chunk):
+                    for wsink, push, _end in members:
+                        for blk in wsink.add(win) or ():
+                            push(blk)
+                outs = []
+                for wsink, push, end in members:
+                    for blk in wsink.finish() or ():
+                        push(blk)
+                    outs.append(end())
+                return outs
             shared = (_SharedScanChunk(chunk)
                       if hasattr(chunk, "read_bytes") else chunk)
             outs = [None] * len(stages)
@@ -594,7 +621,7 @@ class MTRunner(object):
 
         ret = []
         for i in range(len(stages)):
-            _job, combine_op, pin, feeds_reduce = factories[i]
+            _job, combine_op, pin, feeds_reduce, _new_sink = factories[i]
             pset = self._collect_partitions(
                 [outs[i] for outs in results], combine_op, pin, feeds_reduce)
             ret.append((pset, pset.total_records(), len(chunks)))
@@ -622,10 +649,52 @@ class MTRunner(object):
             isinstance(s, GReduce) and stage.output in s.inputs
             for s in self.graph.stages)
 
+        def new_sink():
+            """Push-mode accumulator for one chunk job: push(blk) folds/
+            collects, end() registers and returns the partition mapping.
+            The scan-sharing group executor pushes blocks from a SHARED
+            window pass into several stages' sinks."""
+            raw, partials = [], []
+
+            def push(blk):
+                if blk is None or not len(blk):
+                    return
+                if combine_op is not None:
+                    partials.append(segment.fold_block(blk, combine_op))
+                    if len(partials) >= _PARTIAL_FANIN:
+                        merged = segment.fold_block(
+                            Block.concat(partials), combine_op)
+                        del partials[:]
+                        partials.append(merged)
+                else:
+                    raw.append(blk)
+
+            def end():
+                blocks = raw
+                if combine_op is not None and partials:
+                    blocks = [segment.fold_block(
+                        Block.concat(partials), combine_op)]
+                # Register with the store *inside* the job so the memory
+                # budget is enforced while the stage runs, not after all
+                # jobs complete.  Every registered block is a hash-sorted
+                # run (fold outputs already are; raw blocks sort here —
+                # stable, so equal keys keep input order), which is what
+                # lets over-budget reduces stream a k-way merge instead of
+                # materializing the partition.
+                out = {}
+                for blk in blocks:
+                    if combine_op is None and feeds_reduce:
+                        blk = blk.sort_by_hash()
+                    for pid, sub in blk.split_by_partition(P).items():
+                        out.setdefault(pid, []).append(
+                            self.store.register(sub, pin=pin))
+                return out
+
+            return push, end
+
         def job(chunk):
             mapper = _clone_op(stage.mapper)
             builder = BlockBuilder(settings.batch_size)
-            raw, partials = [], []
             # Vectorized block protocol: mappers exposing map_blocks consume
             # the chunk's raw bytes and emit whole Blocks, skipping the
             # per-record Python path entirely (the SURVEY §7 dual-path).
@@ -639,56 +708,22 @@ class MTRunner(object):
                             and type(mapper) is base.Map
                             and mapper.mapper is base._identity
                             and hasattr(chunk, "iter_blocks"))
-            if use_blocks or ident_blocks:
-                kvs = None
-            elif supplementary:
-                kvs = mapper.map(chunk, *supplementary)
-            else:
-                kvs = mapper.map(chunk)
-
-            def take(blk):
-                if blk is None or not len(blk):
-                    return
-                if combine_op is not None:
-                    partials.append(segment.fold_block(blk, combine_op))
-                    if len(partials) >= _PARTIAL_FANIN:
-                        merged = segment.fold_block(
-                            Block.concat(partials), combine_op)
-                        del partials[:]
-                        partials.append(merged)
-                else:
-                    raw.append(blk)
-
+            push, end = new_sink()
             if use_blocks:
                 for blk in mapper.map_blocks(chunk):
-                    take(blk)
+                    push(blk)
             elif ident_blocks:
                 for blk in chunk.iter_blocks():
-                    take(blk)
+                    push(blk)
             else:
+                kvs = (mapper.map(chunk, *supplementary) if supplementary
+                       else mapper.map(chunk))
                 for k, v in kvs:
-                    take(builder.add(k, v))
-                take(builder.flush())
+                    push(builder.add(k, v))
+                push(builder.flush())
+            return end()
 
-            if combine_op is not None and partials:
-                raw = [segment.fold_block(Block.concat(partials), combine_op)]
-
-            # Register with the store *inside* the job so the memory budget is
-            # enforced while the stage runs, not after all jobs complete.
-            # Every registered block is a hash-sorted run (fold outputs
-            # already are; raw blocks sort here — stable, so equal keys keep
-            # input order), which is what lets over-budget reduces stream a
-            # k-way merge instead of materializing the partition.
-            out = {}
-            for blk in raw:
-                if combine_op is None and feeds_reduce:
-                    blk = blk.sort_by_hash()
-                for pid, sub in blk.split_by_partition(P).items():
-                    out.setdefault(pid, []).append(
-                        self.store.register(sub, pin=pin))
-            return out
-
-        return job, combine_op, pin, feeds_reduce
+        return job, combine_op, pin, feeds_reduce, new_sink
 
     def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True):
         """Block-count governor (the reference's file-count combiner rounds,

@@ -131,7 +131,7 @@ class TestResume:
         second = build().run(name=name, resume=True)
         assert dict(second.stream()) == first
         assert _count(trace) == n1  # nothing re-executed
-        assert all(s["kind"].startswith("resumed-") or s["n_jobs"] == 0
+        assert all(s["kind"].startswith("resumed-") or s["jobs"] == 0
                    for s in second.stats)
 
     def test_changed_lambda_invalidates_only_downstream(self, workdir):
@@ -337,7 +337,7 @@ class TestResume:
         r2 = Dampr.run(w2, n2, name=name, resume=True)
         assert dict(r2[0].stream()) == want_wc
         assert list(r2[1].stream()) == want_n
-        assert all(s["kind"].startswith("resumed-") or s["n_jobs"] == 0
+        assert all(s["kind"].startswith("resumed-") or s["jobs"] == 0
                    for s in r2[0].stats)
 
     def test_resume_off_is_default_and_untouched(self, workdir):
