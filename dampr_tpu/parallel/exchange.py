@@ -43,9 +43,12 @@ from .shuffle import _pad_pow2
 
 
 @functools.lru_cache(maxsize=None)
-def _build_exchange(mesh, axis, capacity):
+def _build_exchange(mesh, axis, capacity, gather=False):
     """One all_to_all program per (mesh, capacity) bucket: moves the byte
-    buffer and the valid-length row across the mesh axis."""
+    buffer and the valid-length row across the mesh axis.  ``gather``
+    (multi-process runs) replicates the delivered buffers with an
+    all_gather so every host process can read the full result — the same
+    scheme as mesh_keyed_fold (shuffle.py)."""
     import jax
     from jax import lax
     from jax.sharding import PartitionSpec as P
@@ -55,13 +58,24 @@ def _build_exchange(mesh, axis, capacity):
         # After all_to_all, row i is what device i sent us.
         rb = lax.all_to_all(bb, axis, split_axis=0, concat_axis=0)
         rl = lax.all_to_all(ln, axis, split_axis=0, concat_axis=0)
+        if gather:
+            rb = lax.all_gather(rb, axis, tiled=True)
+            rl = lax.all_gather(rl, axis, tiled=True)
         return rb, rl
+
+    out_spec = P() if gather else P(axis)
+    kwargs = {}
+    if gather:
+        # all_gather output IS replicated; the varying-axes inference
+        # can't prove it, so disable the check for this variant (same as
+        # mesh_keyed_fold's gather path).
+        kwargs["check_vma"] = False
 
     def program(bb, ln):
         return jax.shard_map(
             per_device, mesh=mesh,
             in_specs=(P(axis), P(axis)),
-            out_specs=(P(axis), P(axis)))(bb, ln)
+            out_specs=(out_spec, out_spec), **kwargs)(bb, ln)
 
     return jax.jit(program)
 
@@ -84,7 +98,10 @@ def mesh_blob_exchange(mesh, blobs):
         lens[row] = len(blob)
         if blob:
             buf[row, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
-    prog = _build_exchange(mesh, settings.mesh_axis, capacity)
+    import jax
+
+    prog = _build_exchange(mesh, settings.mesh_axis, capacity,
+                           gather=jax.process_count() > 1)
     rb, rl = prog(buf, lens)
     rb = np.asarray(rb)
     rl = np.asarray(rl)

@@ -48,6 +48,34 @@ _WORKER = textwrap.dedent("""
     got = {lut[(int(a), int(b))]: int(v)
            for a, b, v in zip(fh1, fh2, fv)}
     assert got == dict(want), "keyed fold diverged on process %d" % pid
+
+    # General byte exchange (exchange.py): route object-valued blocks by
+    # partition id across the process-spanning mesh and verify every
+    # process sees the full delivered set (gather-replicated outputs).
+    from dampr_tpu.blocks import Block
+    from dampr_tpu.parallel.exchange import mesh_shuffle_blocks
+    D = 8
+    routed = []
+    expect = {}
+    seq = 0
+    for src in range(D):
+        for tpid in (src, (src + 3) % D, src + D):
+            ks = np.array(["k%d_%d" % (tpid, src)], dtype=object)
+            vs = np.array([("val", tpid, src)], dtype=object)
+            bh1, bh2 = hashing.hash_keys(ks)
+            routed.append((seq, src, tpid, Block(ks, vs, bh1, bh2)))
+            expect.setdefault(tpid, []).append((seq, ks[0]))
+            seq += 1
+    received, moved = mesh_shuffle_blocks(mesh, routed)
+    assert moved > 0
+    got_pids = {}
+    for rpid, blk in received:
+        for k in blk.keys:
+            got_pids.setdefault(rpid, []).append(k)
+    want_pids = {rpid: [k for _s, k in sorted(entries)]
+                 for rpid, entries in expect.items()}
+    assert got_pids == want_pids, (
+        "general exchange diverged on process %d" % pid)
     print("PROC_%d_OK" % pid, flush=True)
 """).replace("@ROOT@", ROOT)
 
