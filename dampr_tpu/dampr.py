@@ -78,6 +78,18 @@ class PBase(object):
         with the SAME ``name`` skips every stage whose checkpoint is still
         valid (see :mod:`dampr_tpu.resume`).  Requires an explicit name —
         an auto-generated one can never match a previous run.
+
+        Input-file identity is (path, size, mtime_ns) plus a content hash
+        of the first and last 64KB.  An edit that preserves size AND
+        resets mtime AND touches only the interior of a file >128KB is
+        therefore undetectable without a full read; pass a fresh ``name``
+        (or delete the scratch root) after such an edit.
+
+        Starting any run under a name garbage-collects scratch blocks no
+        checkpoint references (skipped while another live process is
+        mid-run under the same name), so finish reading (or materialize)
+        any OutputDataset from a previous run of the same name before
+        rerunning it.
         """
         if kwargs.get("resume") and name is None:
             raise ValueError(

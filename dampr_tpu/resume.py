@@ -36,6 +36,7 @@ import json
 import logging
 import os
 import pickle
+import threading
 import types
 import uuid
 
@@ -46,12 +47,36 @@ log = logging.getLogger("dampr_tpu.resume")
 _VOLATILE = "volatile"
 _MAX_DEPTH = 6
 _MAX_SEQ = 1000
+_TUPLE_END = object()
 
 
 def _h(*parts):
+    """Hash ``parts`` (recursing into tuples) — but if any part is itself a
+    ``volatile:`` fingerprint, the combination is volatile too.  Without
+    this propagation a container holding an unfingerprintable leaf would
+    hash to a random-but-unmarked value: safe (it never matches) but
+    invisible to ``is_volatile`` callers that decide whether a stage's
+    checkpoint files are worth persisting at all."""
     m = hashlib.sha1()
-    for p in parts:
-        m.update(p if isinstance(p, bytes) else str(p).encode("utf-8"))
+    stack = [parts]
+    while stack:
+        p = stack.pop()
+        if p is _TUPLE_END:
+            # Close marker: without it nesting is not injective —
+            # _h(a, (b,), c) and _h(a, (b, c)) would emit identical
+            # byte streams, and a collision here is stale checkpoint
+            # reuse.
+            m.update(b"\x02")
+            continue
+        if isinstance(p, tuple):
+            m.update(b"\x01")
+            stack.append(_TUPLE_END)
+            stack.extend(reversed(p))
+            continue
+        if isinstance(p, str) and is_volatile(p):
+            return _volatile()
+        m.update(p if isinstance(p, (bytes, bytearray, memoryview))
+                 else str(p).encode("utf-8"))
         m.update(b"\x00")
     return m.hexdigest()
 
@@ -61,7 +86,13 @@ def _volatile():
 
 
 def is_volatile(fp):
-    return fp.startswith(_VOLATILE)
+    """Match the exact out-of-band sentinel form ``volatile:<32 hex>`` —
+    a bare prefix test would misfire on user identifiers that happen to
+    start with "volatile" (a function named ``volatile_mapper``, a sink
+    path), silently disabling resume for their stage."""
+    return (len(fp) == len(_VOLATILE) + 33
+            and fp.startswith(_VOLATILE + ":")
+            and all(c in "0123456789abcdef" for c in fp[len(_VOLATILE) + 1:]))
 
 
 def _fp_function(f, depth):
@@ -93,7 +124,11 @@ def _fp(obj, depth=0):
     """Best-effort structural fingerprint.  Deterministic across processes
     for code + plain data; ``volatile:`` (never matches) when it cannot be."""
     if depth > _MAX_DEPTH:
-        return _h("deep", type(obj).__qualname__)
+        # State buried past the depth cap is invisible to the walk; a
+        # stable hash here would let deep edits reuse stale checkpoints.
+        # Volatile is the documented safe direction: lost reuse, never
+        # stale reuse.
+        return _volatile()
     if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
         return _h("prim", repr(obj))
     if isinstance(obj, types.CodeType):
@@ -108,10 +143,42 @@ def _fp(obj, depth=0):
     if isinstance(obj, functools.partial):
         return _h("partial", _fp(obj.func, depth + 1),
                   _fp(obj.args, depth + 1), _fp(obj.keywords, depth + 1))
+    if isinstance(obj, np.ma.MaskedArray):
+        # The mask is semantic state the data buffer doesn't carry: two
+        # arrays with equal data but different masks must not share a
+        # fingerprint.  nomask stays cheap (no materialized mask array).
+        base = np.asarray(obj.data)
+        mask = np.ma.getmask(obj)
+        mfp = ("nomask" if mask is np.ma.nomask
+               else _array_digest(np.asarray(mask)))
+        if base.dtype.hasobject:
+            return _fp_bulk("ma-obj", (obj.shape, str(obj.dtype),
+                                       base.tolist(), mfp,
+                                       repr(obj.fill_value)))
+        return _h("ndarray-masked", obj.shape, str(obj.dtype),
+                  _array_digest(base), mfp, repr(obj.fill_value))
     if isinstance(obj, np.ndarray):
-        if obj.nbytes <= 1 << 20:
-            return _h("ndarray", obj.shape, str(obj.dtype), obj.tobytes())
-        return _h("bigarray", obj.shape, str(obj.dtype))
+        # Content hash at every size: shape+dtype alone would let a
+        # same-shaped array with different CONTENTS match its old
+        # checkpoint (stale reuse).  sha1 streams at ~GB/s — negligible
+        # next to running the stage.  Memoized per fingerprint pass: the
+        # same weight array referenced by several mappers hashes once.
+        cache = getattr(_tls, "cache", None)
+        cached = None if cache is None else cache.get(id(obj))
+        if cached is not None:
+            return cached[1]
+        if obj.dtype.hasobject:
+            # Object buffers hold PyObject POINTERS — hashing them would
+            # miss in-place mutation of the pointees (stale reuse) and
+            # never match across processes; hash the pickled elements.
+            fp = _fp_bulk("ndarray-obj",
+                          (obj.shape, str(obj.dtype), obj.tolist()))
+        else:
+            fp = _h("ndarray", obj.shape, str(obj.dtype),
+                    _array_digest(obj))
+        if cache is not None:
+            cache[id(obj)] = (obj, fp)  # hold obj: pins its id
+        return fp
     if isinstance(obj, np.generic):
         return _h("npscalar", str(obj.dtype), obj.item())
     if isinstance(obj, (tuple, frozenset)):
@@ -161,6 +228,27 @@ def _fp(obj, depth=0):
               tuple(state))
 
 
+def _array_digest(a):
+    """sha1 of an array's element bytes.  Non-contiguous views are copied
+    in ~16MB row chunks, not whole — fingerprinting a multi-GB strided
+    view must not transiently double its memory."""
+    m = hashlib.sha1()
+    if a.flags.c_contiguous:
+        m.update(a.data)
+    elif a.ndim == 0 or a.shape[0] == 0:
+        m.update(np.ascontiguousarray(a).data)
+    else:
+        row_bytes = max(1, a.nbytes // a.shape[0])
+        rows = max(1, (1 << 24) // row_bytes)
+        for i in range(0, a.shape[0], rows):
+            m.update(np.ascontiguousarray(a[i:i + rows]).data)
+    return m.hexdigest()
+
+
+_tls = threading.local()  # per-thread fingerprint-pass cache (two
+# concurrent stage_fingerprints calls must not stomp each other's cache)
+
+
 def _fp_bulk(kind, items):
     """Large payloads: one pickle pass instead of per-item recursion."""
     try:
@@ -181,8 +269,28 @@ def _attr_names(obj):
 # -- taps --------------------------------------------------------------------
 
 def _stat_fp(path):
+    """Input-file identity: (path, size, mtime_ns) + a content probe over
+    the first and last 64KB.  stat alone misses edits that preserve both
+    size and mtime (rsync -t restores, mtime-coarse filesystems, tools
+    that reset timestamps); the probe catches any such edit that touches
+    either end of the file.  A same-size interior-only edit with a reset
+    mtime remains undetectable without a full read — documented in
+    Dampr.run(resume=...)."""
     st = os.stat(path)
-    return (path, st.st_size, st.st_mtime_ns)
+    probe = b""
+    try:
+        with open(path, "rb") as f:
+            probe = f.read(65536)
+            if st.st_size > 131072:
+                f.seek(-65536, os.SEEK_END)
+                probe += f.read(65536)
+            elif st.st_size > 65536:
+                f.seek(65536)
+                probe += f.read()
+    except OSError:
+        pass
+    return (path, st.st_size, st.st_mtime_ns,
+            hashlib.sha1(probe).hexdigest())
 
 
 def _fp_tap(tap):
@@ -227,27 +335,31 @@ def stage_fingerprints(graph, salt=""):
 
     src_fp = {}
     out = {}
-    for sid, stage in enumerate(graph.stages):
-        if isinstance(stage, GInput):
-            src_fp[stage.output] = _h("tap-salted", salt, _fp_tap(stage.tap))
-            continue
-        inputs = tuple(src_fp.get(s, "missing") for s in stage.inputs)
-        if isinstance(stage, GMap):
-            body = ("map", _fp(stage.mapper), _fp(stage.combiner),
-                    _fp(stage.shuffler))
-        elif isinstance(stage, GReduce):
-            body = ("reduce", _fp(stage.reducer))
-        elif isinstance(stage, GSink):
-            body = ("sink", _fp(stage.sinker), stage.path)
-        else:
-            body = ("other", _fp(stage))
-        opts = _fp(getattr(stage, "options", None) or {})
-        if any(is_volatile(x) for x in inputs) or is_volatile(opts):
-            fp = _volatile()
-        else:
+    _tls.cache = {}  # one content hash per distinct captured array
+    try:
+        for sid, stage in enumerate(graph.stages):
+            if isinstance(stage, GInput):
+                src_fp[stage.output] = _h(
+                    "tap-salted", salt, _fp_tap(stage.tap))
+                continue
+            inputs = tuple(src_fp.get(s, "missing") for s in stage.inputs)
+            if isinstance(stage, GMap):
+                body = ("map", _fp(stage.mapper), _fp(stage.combiner),
+                        _fp(stage.shuffler))
+            elif isinstance(stage, GReduce):
+                body = ("reduce", _fp(stage.reducer))
+            elif isinstance(stage, GSink):
+                body = ("sink", _fp(stage.sinker), stage.path)
+            else:
+                body = ("other", _fp(stage))
+            opts = _fp(getattr(stage, "options", None) or {})
+            # _h propagates volatility: any volatile part (inputs, opts,
+            # body fps) makes the combination volatile.
             fp = _h("stage", sid, body, opts, inputs)
-        src_fp[stage.output] = fp
-        out[sid] = fp
+            src_fp[stage.output] = fp
+            out[sid] = fp
+    finally:
+        _tls.cache = None
     return out
 
 
@@ -332,6 +444,20 @@ def _manifest_files(root, sid):
     return set(os.path.join(root, b[1]) for b in m.get("blocks", ()))
 
 
+def _live_paths(root):
+    """Absolute paths of every file referenced by any current manifest."""
+    live = set()
+    mdir = _manifest_dir(root)
+    if os.path.isdir(mdir):
+        for name in os.listdir(mdir):
+            if name.startswith("stage_") and name.endswith(".json"):
+                sid = name[len("stage_"):-len(".json")]
+                if sid.isdigit():
+                    live |= set(map(os.path.abspath,
+                                    _manifest_files(root, int(sid))))
+    return live
+
+
 def _prune(root, candidates):
     """Delete superseded checkpoint files: ``candidates`` (the replaced
     manifest's files) minus every path still referenced by any current
@@ -341,20 +467,93 @@ def _prune(root, candidates):
     if not candidates:
         return
     rootp = os.path.join(os.path.abspath(root), "")
-    live = set()
-    mdir = _manifest_dir(root)
-    if os.path.isdir(mdir):
-        for name in os.listdir(mdir):
-            if name.startswith("stage_") and name.endswith(".json"):
-                sid = name[len("stage_"):-len(".json")]
-                if sid.isdigit():
-                    live |= _manifest_files(root, int(sid))
-    for path in candidates - live:
-        if os.path.abspath(path).startswith(rootp):
+    live = _live_paths(root)
+    for path in candidates:
+        path = os.path.abspath(path)
+        if path not in live and path.startswith(rootp):
             try:
                 os.unlink(path)
             except OSError:
                 pass
+
+
+class RunGuard(object):
+    """Advisory liveness lock for a named scratch root.  Every resumable
+    run holds a SHARED flock on ``<root>/.run.lock`` for its duration; the
+    start-of-run GC sweep only fires when an EXCLUSIVE probe succeeds —
+    i.e. no other live process is mid-run under this name, so no in-flight
+    (not-yet-manifested) spill blocks can be swept.  flock releases on
+    process death, so a crashed run never wedges the GC forever."""
+
+    def __init__(self, root):
+        import errno
+        import fcntl
+        os.makedirs(root, exist_ok=True)
+        self._fcntl = fcntl
+        self._fd = os.open(os.path.join(root, ".run.lock"),
+                           os.O_CREAT | os.O_RDWR, 0o644)
+        self.exclusive = False
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            self.exclusive = True
+        except OSError as e:
+            if e.errno in (errno.EWOULDBLOCK, errno.EAGAIN):
+                # Another live run holds the lock: join it shared.
+                fcntl.flock(self._fd, fcntl.LOCK_SH)
+            else:
+                # Filesystem without flock support (NFS sans lockd,
+                # some container mounts): degrade to no-GC rather than
+                # fail the run — locking is an optimization guard, not
+                # a correctness requirement for a single process.
+                log.warning("flock unsupported on %s (%s): skipping "
+                            "start-of-run GC", root, e)
+                os.close(self._fd)
+                self._fd = None
+
+    def share(self):
+        """Downgrade to shared so later runs can probe while we execute."""
+        if self.exclusive and self._fd is not None:
+            self._fcntl.flock(self._fd, self._fcntl.LOCK_SH)
+            self.exclusive = False
+
+    def close(self):
+        if self._fd is not None:
+            try:
+                self._fcntl.flock(self._fd, self._fcntl.LOCK_UN)
+            finally:
+                os.close(self._fd)
+                self._fd = None
+
+
+def gc_unreferenced(root):
+    """Delete ``.blk`` files under ``root`` not referenced by any current
+    manifest.  Called at run START (nothing in flight): volatile stages —
+    including a pipeline's FINAL output when its fingerprint is volatile —
+    persist no manifest, so their spilled blocks from previous runs are
+    unreachable garbage; without this sweep the named scratch root grows
+    without bound across reruns.
+
+    Contract (documented on ``run``): starting a new run under a name
+    invalidates any still-unread OutputDataset from a PREVIOUS run of
+    that name whose final stage was volatile — its backing blocks are
+    exactly the unreachable files this sweep removes."""
+    if not os.path.isdir(root):
+        return
+    live = _live_paths(root)
+    n = 0
+    for d, _dirs, fs in os.walk(root):
+        for f in fs:
+            if not f.endswith(".blk"):
+                continue
+            path = os.path.join(d, f)
+            if os.path.abspath(path) not in live:
+                try:
+                    os.unlink(path)
+                    n += 1
+                except OSError:
+                    pass
+    if n:
+        log.info("resume gc: removed %d unreferenced block file(s)", n)
 
 
 def load_plan(root, fps):

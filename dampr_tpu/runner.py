@@ -1317,10 +1317,28 @@ class MTRunner(object):
         return self._run(outputs, cleanup)
 
     def _run(self, outputs, cleanup=True):
+        from . import resume as _resume
+        # EVERY run holds the scratch root's liveness lock — named roots
+        # are shared across runs whether or not they resume, and a
+        # concurrent run's in-flight spill blocks are not manifest-
+        # referenced until its stage completes.  The GC sweep fires only
+        # when the exclusive probe proves no other live run is mid-flight
+        # under this name; we then downgrade to shared for our duration.
+        guard = _resume.RunGuard(self.store.root)
+        if guard.exclusive:
+            _resume.gc_unreferenced(self.store.root)
+        guard.share()
+        try:
+            return self._run_stages(outputs, cleanup)
+        finally:
+            guard.close()
+
+    def _run_stages(self, outputs, cleanup):
         env = {}
         to_delete = []
         fused = {}  # sid -> (pset, nrec, njobs) computed by an earlier pass
         plan, stage_fps = {}, {}
+        volatile_sources = set()
         n_stages = len(self.graph.stages)
         required = None  # None = every stage (the non-resume fast path)
         from . import resume as _resume
@@ -1411,6 +1429,8 @@ class MTRunner(object):
             if self.resume:
                 _resume.persist_stage(
                     self.store, sid, stage_fps[sid], result, nrec)
+                if _resume.is_volatile(stage_fps[sid]):
+                    volatile_sources.add(stage.output)
             st = StageStats(sid, kind)
             st.n_jobs = njobs
             st.records_out = nrec
@@ -1438,12 +1458,15 @@ class MTRunner(object):
                     continue
                 entry = env.get(source)
                 if isinstance(entry, storage.PartitionSet):
-                    if self.resume:
+                    if self.resume and source not in volatile_sources:
                         # Durable runs keep intermediate checkpoints on disk
                         # (a modified rerun resumes from the longest valid
                         # prefix) but release RAM residency now.
                         entry.release(self.store)
                     else:
+                        # Volatile stages persist no manifest and can never
+                        # be resumed — retaining their spilled blocks would
+                        # grow the named scratch root without bound.
                         entry.delete(self.store)
 
         return ret

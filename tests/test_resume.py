@@ -350,3 +350,92 @@ class TestResume:
                .run(name=name))
         assert dict(out.stream()) == {0: 5, 1: 5}
         assert not os.path.isdir(os.path.join(_run_root(name), "manifest"))
+
+
+class TestFingerprintSharpness:
+    """Regression tests for the round-3 advisor findings: fingerprints must
+    never collide across semantically different captured state (stale reuse
+    is the one unforgivable failure mode)."""
+
+    def test_big_array_content_change_invalidates(self):
+        import numpy as np
+        from dampr_tpu import resume
+        a = np.zeros(1 << 18, dtype=np.float64)  # 2MB: above the old 1MB cap
+        b = a.copy()
+        assert resume._fp(a) == resume._fp(b)
+        b[12345] = 1.0  # same shape, same dtype, different CONTENTS
+        assert resume._fp(a) != resume._fp(b)
+
+    def test_noncontiguous_array_fingerprints_by_content(self):
+        import numpy as np
+        from dampr_tpu import resume
+        base = np.arange(64).reshape(8, 8)
+        view = base[:, ::2]  # non-contiguous
+        assert resume._fp(view) == resume._fp(view.copy())
+
+    def test_depth_cap_is_volatile(self):
+        from dampr_tpu import resume
+        deep = "leaf"
+        for _ in range(resume._MAX_DEPTH + 2):
+            deep = [deep]
+        fp1, fp2 = resume._fp(deep), resume._fp(deep)
+        # State buried past the cap is invisible — must never produce a
+        # stable (reusable) fingerprint.
+        assert resume.is_volatile(fp1) and resume.is_volatile(fp2)
+        assert fp1 != fp2
+
+    def test_same_size_same_mtime_edit_detected(self, workdir):
+        from dampr_tpu import resume
+        path = os.path.join(workdir, "data.txt")
+        with open(path, "w") as f:
+            f.write("aaaa\nbbbb\n")
+        st = os.stat(path)
+        fp1 = resume._stat_fp(path)
+        with open(path, "w") as f:
+            f.write("aaaa\ncccc\n")  # same size
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))  # restore mtime
+        fp2 = resume._stat_fp(path)
+        assert fp1 != fp2  # the 64KB content probe catches it
+
+    def test_volatile_stage_blocks_are_pruned(self, workdir):
+        """A stage capturing unfingerprintable state persists no manifest;
+        its spilled blocks must be deleted at cleanup, not retained forever
+        in the named scratch root."""
+        name = "resume-volatile-prune"
+        _fresh(name)
+
+        class Opaque:
+            # No __dict__ attrs, not picklable -> _fp returns volatile.
+            __slots__ = ()
+
+            def __reduce__(self):
+                raise TypeError("nope")
+
+            def __call__(self, x):
+                return (x % 3, 1)
+
+        def build():
+            return (Dampr.memory(list(range(30)), partitions=4)
+                    .map(Opaque())
+                    .fold_by(lambda kv: kv[0], value=lambda kv: kv[1],
+                             binop=lambda a, b: a + b))
+
+        def blk_files():
+            root = _run_root(name)
+            out = []
+            for d, _dirs, fs in os.walk(root):
+                out.extend(os.path.join(d, f) for f in fs
+                           if f.endswith(".blk"))
+            return out
+
+        # memory_budget=1 forces every block to disk
+        got1 = dict(build().run(name=name, resume=True,
+                                memory_budget=1).stream())
+        n1 = len(blk_files())
+        got2 = dict(build().run(name=name, resume=True,
+                                memory_budget=1).stream())
+        n2 = len(blk_files())
+        assert got1 == got2 == {0: 10, 1: 10, 2: 10}
+        # Volatile stages can never be resumed; reruns must not accumulate
+        # their spill files.
+        assert n2 <= n1
