@@ -123,16 +123,266 @@ def fuse(aggs):
 
 
 def is_pure_record_stream(m):
-    """True when a (possibly fused) mapper chains only plain ``Map`` steps,
-    so records transform independently and chunk granularity is mechanical.
-    False for anything carrying per-chunk semantics (StreamMapper observes
-    whole-partition iterators, BlockMapper has a per-chunk lifecycle) —
-    the runner's tiny-input collapse must not merge those chunks."""
-    if type(m) is Map:
+    """True when a (possibly fused) mapper chains only plain ``Map`` /
+    ``RecordOp`` steps, so records transform independently and chunk
+    granularity is mechanical.  False for anything carrying per-chunk
+    semantics (StreamMapper observes whole-partition iterators, BlockMapper
+    has a per-chunk lifecycle) — the runner's tiny-input collapse must not
+    merge those chunks."""
+    if type(m) is Map or isinstance(m, RecordOp):
         return True
     if type(m) in (ComposedMapper, ComposedStreamable):
         return is_pure_record_stream(m.left) and is_pure_record_stream(m.right)
     return False
+
+
+# ---------------------------------------------------------------------------
+# Typed record ops: the DSL's per-record transforms with a BATCH lowering
+# ---------------------------------------------------------------------------
+
+class RecordOp(Mapper, Streamable):
+    """A typed per-record transform the engine can execute over whole
+    batches: ``apply_batch(keys, values) -> (keys, values)`` transforms
+    parallel Python lists in tight list-comprehension loops (one C-level
+    loop per op per batch) instead of threading every record through a
+    chain of nested generator frames.  ``stream`` remains as the record-
+    at-a-time lowering for paths that need a generator.
+
+    Equivalence note: a fused generator chain interleaves ops per record
+    (op2 sees record 1 before op1 sees record 2); the batch lowering runs
+    op1 over the whole batch first.  For per-record-pure functions — the
+    DSL contract — the outputs are identical, and each op still sees
+    records in stream order, so self-contained stateful UDFs (a dedupe
+    filter's seen-set) behave the same.  Only state shared ACROSS two ops
+    of one chain could observe the difference; batch size bounds it."""
+
+    def map(self, *datasets):
+        assert len(datasets) == 1
+        return self.stream(datasets[0].read())
+
+    def apply_batch(self, ks, vs):
+        raise NotImplementedError()
+
+
+class ValueMap(RecordOp):
+    """value -> f(value)  (PMap.map)."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        return ks, [f(v) for v in vs]
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            yield k, f(v)
+
+    def __repr__(self):
+        return "ValueMap[{}]".format(getattr(self.f, "__name__", self.f))
+
+
+class MapValues(RecordOp):
+    """(a, b) -> (a, f(b))  (PMap.map_values)."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        return ks, [(v[0], f(v[1])) for v in vs]
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            yield k, (v[0], f(v[1]))
+
+
+class MapKeys(RecordOp):
+    """(a, b) -> (f(a), b)  (PMap.map_keys)."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        return ks, [(f(v[0]), v[1]) for v in vs]
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            yield k, (f(v[0]), v[1])
+
+
+class Prefix(RecordOp):
+    """value -> (f(value), value)."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        return ks, [(f(v), v) for v in vs]
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            yield k, (f(v), v)
+
+
+class Suffix(RecordOp):
+    """value -> (value, f(value))."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        return ks, [(v, f(v)) for v in vs]
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            yield k, (v, f(v))
+
+
+class Filter(RecordOp):
+    """Keep records whose value satisfies the predicate."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        sel = [bool(f(v)) for v in vs]
+        if all(sel):
+            return ks, vs
+        return ([k for k, s in zip(ks, sel) if s],
+                [v for v, s in zip(vs, sel) if s])
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            if f(v):
+                yield k, v
+
+    def __repr__(self):
+        return "Filter[{}]".format(getattr(self.f, "__name__", self.f))
+
+
+class FlatMap(RecordOp):
+    """value -> iterable, flattened; the key repeats per emitted element."""
+
+    def __init__(self, f):
+        self.f = f
+
+    def apply_batch(self, ks, vs):
+        f = self.f
+        nks, nvs = [], []
+        ext_k, ext_v = nks.extend, nvs.extend
+        for k, v in zip(ks, vs):
+            out = f(v)
+            out = out if isinstance(out, (list, tuple)) else list(out)
+            ext_v(out)
+            ext_k([k] * len(out))
+        return nks, nvs
+
+    def stream(self, kvs):
+        f = self.f
+        for k, v in kvs:
+            for vi in f(v):
+                yield k, vi
+
+    def __repr__(self):
+        return "FlatMap[{}]".format(getattr(self.f, "__name__", self.f))
+
+
+class Rekey(RecordOp):
+    """(k, v) -> (key_f(v), value_f(v)) — the shuffle re-key every
+    group_by / a_group_by / sort_by plants.  Splitting key and value
+    extraction into two tight loops keeps each a single-call batch pass."""
+
+    def __init__(self, key_f, value_f=None):
+        self.key_f = key_f
+        self.value_f = value_f
+
+    def apply_batch(self, ks, vs):
+        key_f, value_f = self.key_f, self.value_f
+        nks = [key_f(v) for v in vs]
+        return nks, (vs if value_f is None else [value_f(v) for v in vs])
+
+    def stream(self, kvs):
+        key_f, value_f = self.key_f, self.value_f
+        if value_f is None:
+            for _k, v in kvs:
+                yield key_f(v), v
+        else:
+            for _k, v in kvs:
+                yield key_f(v), value_f(v)
+
+    def __repr__(self):
+        return "Rekey[{}]".format(getattr(self.key_f, "__name__", self.key_f))
+
+
+class Sample(RecordOp):
+    """Keep each record with probability ``prob``; draws come from the
+    injected thread-local RNG factory in stream order, so batch and
+    per-record lowerings consume the identical random sequence."""
+
+    def __init__(self, prob, rand_factory):
+        self.prob = prob
+        self.rand_factory = rand_factory
+
+    def apply_batch(self, ks, vs):
+        rnd = self.rand_factory().random
+        prob = self.prob
+        sel = [rnd() < prob for _ in vs]
+        return ([k for k, s in zip(ks, sel) if s],
+                [v for v, s in zip(vs, sel) if s])
+
+    def stream(self, kvs):
+        rnd = self.rand_factory().random
+        prob = self.prob
+        for k, v in kvs:
+            if rnd() < prob:
+                yield k, v
+
+
+class Inspect(RecordOp):
+    """Debug passthrough: print each value as it streams."""
+
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+
+    def apply_batch(self, ks, vs):
+        for v in vs:
+            print("{}: {}".format(self.prefix, v))
+        return ks, vs
+
+    def stream(self, kvs):
+        for k, v in kvs:
+            print("{}: {}".format(self.prefix, v))
+            yield k, v
+
+
+def record_op_chain(m):
+    """Flatten a (possibly fused) mapper into an ordered [RecordOp] list, or
+    None when any link lacks a batch lowering.  ``Map(_identity)`` links
+    contribute nothing and drop out."""
+    out = []
+
+    def walk(node):
+        if isinstance(node, RecordOp):
+            out.append(node)
+            return True
+        if type(node) is Map and node.mapper is _identity:
+            return True
+        if type(node) in (ComposedMapper, ComposedStreamable):
+            return walk(node.left) and walk(node.right)
+        return False
+
+    return out if walk(m) else None
 
 
 class BlockMapper(Mapper, Streamable):

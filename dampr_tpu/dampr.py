@@ -25,10 +25,12 @@ import sys
 import threading
 import time
 
-from .base import (AssocFoldReducer, KeyedInnerJoin, KeyedLeftJoin,
-                   KeyedOuterJoin, KeyedReduce, Map, MapAllJoin, MapCrossJoin,
-                   Mapper, PartialReduceCombiner, Reducer, StreamMapper,
-                   StreamReducer, Streamable, _identity, fuse)
+from .base import (AssocFoldReducer, Filter, FlatMap, Inspect, KeyedInnerJoin,
+                   KeyedLeftJoin, KeyedOuterJoin, KeyedReduce, Map, MapAllJoin,
+                   MapCrossJoin, MapKeys, MapValues, Mapper,
+                   PartialReduceCombiner, Prefix, Reducer, Rekey, Sample,
+                   StreamMapper, StreamReducer, Streamable, Suffix, ValueMap,
+                   _identity, fuse)
 from .dataset import CatDataset, Chunker
 from .graph import Graph, Source
 from .inputs import MemoryInput, PathInput, UrlsInput
@@ -140,85 +142,63 @@ class PMap(PBase):
         return self
 
     # -- per-record ops ----------------------------------------------------
+    # Each queues a typed RecordOp (base.py): the engine executes chains of
+    # these over whole batches — one tight loop per op per batch — instead
+    # of per-record generator frames, and falls back to their stream()
+    # lowering wherever a generator is needed.
     def map(self, f):
         """Map each value through ``f``."""
-        def _map(k, v):
-            yield k, f(v)
-        return self._add_map(_map)
+        return self._add_mapper(ValueMap(f))
 
     def map_values(self, f):
         """Map the second element of two-tuple values."""
-        def _map_values(k, v):
-            yield k, (v[0], f(v[1]))
-        return self._add_map(_map_values)
+        return self._add_mapper(MapValues(f))
 
     def map_keys(self, f):
         """Map the first element of two-tuple values."""
-        def _map_keys(k, v):
-            yield k, (f(v[0]), v[1])
-        return self._add_map(_map_keys)
+        return self._add_mapper(MapKeys(f))
 
     def prefix(self, f):
         """value -> (f(value), value)."""
-        def _map_prefix(k, v):
-            yield k, (f(v), v)
-        return self._add_map(_map_prefix)
+        return self._add_mapper(Prefix(f))
 
     def suffix(self, f):
         """value -> (value, f(value))."""
-        def _map_suffix(k, v):
-            yield k, (v, f(v))
-        return self._add_map(_map_suffix)
+        return self._add_mapper(Suffix(f))
 
     def filter(self, f):
         """Keep values where predicate holds."""
-        def _filter(k, v):
-            if f(v):
-                yield k, v
-        return self._add_map(_filter)
+        return self._add_mapper(Filter(f))
 
     def flat_map(self, f):
         """Map values to iterables and flatten."""
-        def _flat_map(k, v):
-            for vi in f(v):
-                yield k, vi
-        return self._add_map(_flat_map)
+        return self._add_mapper(FlatMap(f))
 
     def sample(self, prob):
         """Uniformly keep ``prob`` of records."""
         assert 0 <= prob <= 1.0
-
-        def _sample(k, v):
-            if _get_rand().random() < prob:
-                yield k, v
-        return self._add_map(_sample)
+        return self._add_mapper(Sample(prob, _get_rand))
 
     def inspect(self, prefix="", exit=False):
         """Print records as they stream through (debug passthrough)."""
-        def _inspect(k, v):
-            print("{}: {}".format(prefix, v))
-            yield k, v
-
-        ins = self._add_map(_inspect)
+        ins = self._add_mapper(Inspect(prefix))
         if exit:
             ins.run()
             sys.exit(0)
         return ins
 
     # -- grouping ----------------------------------------------------------
-    def group_by(self, key, vf=lambda x: x):
-        """General (non-associative) grouping; returns PReduce."""
-        def _group_by(_key, value):
-            yield key(value), vf(value)
-        pm = self._add_map(_group_by).checkpoint()
+    def group_by(self, key, vf=None):
+        """General (non-associative) grouping; returns PReduce.  ``vf``
+        defaults to the identity (records keep their value)."""
+        pm = self._add_mapper(Rekey(key, vf)).checkpoint()
         return PReduce(pm.source, pm.pmer)
 
-    def a_group_by(self, key, vf=lambda x: x):
+    def a_group_by(self, key, vf=None):
         """Associative grouping: enables map-side combining before the
-        shuffle (no checkpoint until the binop is known)."""
-        def _a_group_by(_key, value):
-            yield key(value), vf(value)
-        pm = self._add_map(_a_group_by)
+        shuffle (no checkpoint until the binop is known).  ``vf`` defaults
+        to the identity."""
+        pm = self._add_mapper(Rekey(key, vf))
         return ARReduce(pm)
 
     def fold_by(self, key, binop, value=lambda x: x, **options):
@@ -238,9 +218,7 @@ class PMap(PBase):
 
     def sort_by(self, key, **options):
         """Globally sort values by a key function (results merge key-sorted)."""
-        def _sort_by(_key, value):
-            yield key(value), value
-        return self._add_map(_sort_by).checkpoint(options=options)
+        return self._add_mapper(Rekey(key)).checkpoint(options=options)
 
     def count(self, key=lambda x: x, **options):
         """Count values per key — compiles to a device segment-sum."""
