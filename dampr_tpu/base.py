@@ -38,6 +38,21 @@ class Splitter(object):
 # Mappers
 # ---------------------------------------------------------------------------
 
+def _shared_instance_deepcopy(self, memo):
+    """``__deepcopy__`` body for operators with no per-chunk state: the
+    runner's per-job clone (runner._clone_op) shares the instance, so the
+    user callable inside is never deep-copied — it may hold uncopyable
+    resources (open files, sockets, loaded models).  Trade-off, stated
+    honestly: the fork-based reference gave mutating UDFs copy-on-write
+    isolation per worker; a thread-pool runner cannot, so a callable
+    *object* that mutates its own attributes now shares that state across
+    concurrent jobs and must be thread-safe (plain functions/closures were
+    always shared — deepcopy treats functions as atomic).  Per-job mutable
+    state belongs in the BlockMapper/BlockReducer lifecycle, which IS
+    deep-copied."""
+    return self
+
+
 class Mapper(object):
     """Lowest-level map interface: consume whole datasets, yield (k, v)."""
 
@@ -67,6 +82,8 @@ def _identity(k, v):
 
 class Map(Mapper, Streamable):
     """Wraps a generator function ``f(k, v) -> iterable[(k, v)]``."""
+
+    __deepcopy__ = _shared_instance_deepcopy
 
     def __init__(self, mapper):
         assert not isinstance(mapper, Mapper)
@@ -153,8 +170,11 @@ class RecordOp(Mapper, Streamable):
     op1 over the whole batch first.  For per-record-pure functions — the
     DSL contract — the outputs are identical, and each op still sees
     records in stream order, so self-contained stateful UDFs (a dedupe
-    filter's seen-set) behave the same.  Only state shared ACROSS two ops
-    of one chain could observe the difference; batch size bounds it."""
+    filter's seen-set) behave the same within one stream.  Only state
+    shared ACROSS two ops of one chain could observe the difference; batch
+    size bounds it.  Note that UDF instances are shared across concurrent
+    jobs (see ``_shared_instance_deepcopy``): a mutating callable-object
+    UDF observes all partitions' records and must be thread-safe."""
 
     def map(self, *datasets):
         assert len(datasets) == 1
@@ -162,6 +182,10 @@ class RecordOp(Mapper, Streamable):
 
     def apply_batch(self, ks, vs):
         raise NotImplementedError()
+
+    # No per-chunk state (Sample re-derives its RNG per stream), so per-job
+    # clones share the instance and never deep-copy the user callable.
+    __deepcopy__ = _shared_instance_deepcopy
 
 
 class ValueMap(RecordOp):
@@ -418,6 +442,8 @@ class BlockMapper(Mapper, Streamable):
 class StreamMapper(Mapper, Streamable):
     """Whole-partition generator mapper: ``f(value_iter) -> iterable[(k, v)]``."""
 
+    __deepcopy__ = _shared_instance_deepcopy
+
     def __init__(self, streamer_f):
         self.streamer_f = streamer_f
 
@@ -455,6 +481,8 @@ class MapCrossJoin(Mapper):
     """Map-side cross product; with ``cache`` the right side is pinned in RAM
     (broadcast join — reference base.py:139-163)."""
 
+    __deepcopy__ = _shared_instance_deepcopy
+
     def __init__(self, crosser, cache=False):
         self.crosser = crosser
         self.cache = cache
@@ -479,6 +507,8 @@ class MapCrossJoin(Mapper):
 class MapAllJoin(Mapper):
     """Loads the whole right side through an aggregate fn, passes it to every
     left record (reference base.py:165-178)."""
+
+    __deepcopy__ = _shared_instance_deepcopy
 
     def __init__(self, crosser, load_f=lambda d: [v for _k, v in d]):
         self.crosser = crosser
@@ -755,6 +785,8 @@ class Reducer(object):
 class Reduce(Reducer):
     """``f(key, value_iter) -> value`` per group (reference base.py:197-207)."""
 
+    __deepcopy__ = _shared_instance_deepcopy
+
     def __init__(self, reducer):
         self.reducer = reducer
 
@@ -802,6 +834,8 @@ class StreamReducer(Reducer):
     values are wrapped as (k, v) pairs (reference base.py:233-251).  Runs on
     empty partitions too — documented reference behavior."""
 
+    __deepcopy__ = _shared_instance_deepcopy
+
     def __init__(self, stream_f):
         self.stream_f = stream_f
 
@@ -826,6 +860,8 @@ class AssocFoldReducer(Reducer):
     opaque binops fold on host over the sorted groups.  Output value is the
     (k, acc) pair, matching KeyedReduce semantics.
     """
+
+    __deepcopy__ = _shared_instance_deepcopy
 
     def __init__(self, op):
         self.op = segment.as_assoc_op(op)
@@ -884,6 +920,8 @@ class InnerJoin(Reducer):
     """Sort-merge inner join over two co-partitioned grouped views
     (reference base.py:259-283)."""
 
+    __deepcopy__ = _shared_instance_deepcopy
+
     def __init__(self, joiner_f, many=False):
         self.joiner_f = joiner_f
         self.many = many
@@ -912,6 +950,8 @@ class LeftJoin(Reducer):
     """Sort-merge left join; missing right groups get ``default()``
     (reference base.py:290-315)."""
 
+    __deepcopy__ = _shared_instance_deepcopy
+
     def __init__(self, joiner_f, default=lambda: iter(())):
         self.joiner_f = joiner_f
         self.default = default
@@ -939,6 +979,8 @@ class OuterJoin(Reducer):
     variable bugs (reference base.py:355, 366 — never exposed by its DSL);
     this is the corrected behavior, exposed as a new capability
     (PJoin.outer_reduce)."""
+
+    __deepcopy__ = _shared_instance_deepcopy
 
     def __init__(self, joiner_f, default=lambda: iter(())):
         self.joiner_f = joiner_f

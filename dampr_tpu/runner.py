@@ -47,10 +47,17 @@ _PARTIAL_FANIN = 8
 
 
 def _clone_op(op):
-    """Per-job operator instance.  Stateful operators (BlockMapper/BlockReducer
-    subclasses) carry per-chunk state; the reference isolates them by process
-    fork, we by deep copy (functions/closures are copied by reference, which
-    is safe — they are not mutated)."""
+    """Per-job operator instance.  The built-in stateless wrapper ops
+    (Map/RecordOps/StreamMapper/Reduce/joins/…) define ``__deepcopy__`` as
+    share-by-reference (base._shared_instance_deepcopy), so user callables —
+    which may hold uncopyable resources — are never descended into.
+    Everything else still deep-copies: BlockMapper/BlockReducer lifecycle
+    ops (per-chunk state the reference isolated by process fork) and
+    unknown user Mapper/Reducer subclasses installed via custom_mapper /
+    custom_reducer, which may be stateful — such a subclass holding an
+    uncopyable resource should define ``__deepcopy__`` itself.  deepcopy of
+    a fused Composed chain reaches the stateful leaves while sharing the
+    rest."""
     return copy.deepcopy(op)
 
 

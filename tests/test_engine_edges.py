@@ -179,3 +179,94 @@ class TestMultiOutputUnderPressure:
         finally:
             (settings.partitions, settings.mesh_exchange,
              settings.mesh_fold) = old
+
+
+class TestUncopyableUDFs:
+    """Per-job operator cloning must share the user callable by reference.
+
+    The reference gets this for free from fork (children inherit the object
+    graph); our thread-pool runner deep-copies operators per job, and a
+    RecordOp holding a UDF whose closure/attributes include an uncopyable
+    resource (open file, socket, model handle) must not crash the run."""
+
+    def test_map_with_open_file_handle(self, tmp_path):
+        p = tmp_path / "lookup.txt"
+        p.write_text("10\n")
+        fh = open(p)
+
+        class Lookup:
+            def __init__(self, handle):
+                self.handle = handle  # TextIOWrapper: not deepcopy-able
+                self.scale = int(handle.read().strip())
+
+            def __call__(self, x):
+                # Deliberately no per-call handle use: the shared instance
+                # is called from concurrent jobs and must stay thread-safe.
+                return x * self.scale
+
+        try:
+            out = Dampr.memory(list(range(20))).map(Lookup(fh)).run()
+            assert sorted(out.read()) == [i * 10 for i in range(20)]
+        finally:
+            fh.close()
+
+    def test_every_record_op_shares_udf(self, tmp_path):
+        # Callable *objects* with an uncopyable attribute (deepcopy treats
+        # plain functions as atomic, so lambdas would not exercise the
+        # share-by-reference __deepcopy__; instance attributes do).
+        fh = open(tmp_path / "f.txt", "w")
+
+        class Udf:
+            def __init__(self, fn):
+                self.handle = fh  # TextIOWrapper: not deepcopy-able
+                self.fn = fn
+
+            def __call__(self, *a):
+                return self.fn(*a)
+
+        try:
+            out = (Dampr.memory(list(range(50)))
+                   .map(Udf(lambda x: x))
+                   .filter(Udf(lambda x: x % 2 == 0))
+                   .flat_map(Udf(lambda x: [x, x]))
+                   .map(Udf(lambda x: x + 1))
+                   .run())
+            got = sorted(out.read())
+            want = sorted([x + 1 for x in range(0, 50, 2) for _ in (0, 1)])
+            assert got == want
+        finally:
+            fh.close()
+
+    def test_reduce_and_join_share_udf(self, tmp_path):
+        # The same share-by-reference policy must cover reducers and joins,
+        # not just RecordOps: group_by().reduce, fold_by, and join all hold
+        # user callables the runner must never deep-copy.
+        fh = open(tmp_path / "f.txt", "w")
+
+        class Udf:
+            def __init__(self, fn):
+                self.handle = fh
+                self.fn = fn
+
+            def __call__(self, *a):
+                return self.fn(*a)
+
+        try:
+            data = Dampr.memory(list(range(40)))
+            grouped = (data
+                       .group_by(Udf(lambda x: x % 4))
+                       .reduce(Udf(lambda k, it: sum(it))))
+            folded = data.fold_by(Udf(lambda x: x % 4),
+                                  binop=Udf(lambda a, b: a + b))
+            joined = grouped.join(folded).reduce(
+                Udf(lambda l, r: (sum(v for _, v in l),
+                                  sum(v for _, v in r))))
+            outs = Dampr.run(grouped, folded, joined)
+            want = {k: sum(x for x in range(40) if x % 4 == k)
+                    for k in range(4)}
+            assert dict(outs[0].read()) == want
+            assert dict(outs[1].read()) == want
+            assert dict(outs[2].read()) == {k: (v, v)
+                                            for k, v in want.items()}
+        finally:
+            fh.close()
