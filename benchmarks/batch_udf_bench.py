@@ -1,0 +1,95 @@
+"""Opaque-UDF batch execution benchmark (SURVEY §7 hard part 1).
+
+Pipeline: JSON-lines text -> json.loads -> field extract -> filter ->
+fold_by(count).  Every op is an opaque Python lambda, so nothing can ride
+the vectorized text kernels — this isolates exactly the per-record
+generator chain the reference pays (ref stagerunner.py:73-74) against our
+batched ``apply_batch`` lowering.
+
+Usage: python benchmarks/batch_udf_bench.py [--size-mb 1024]
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dampr_tpu import Dampr, settings  # noqa: E402
+
+
+def make_input(path, size_mb):
+    rnd = random.Random(7)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+    target = size_mb * 1024 * 1024
+    n = 0
+    with open(path, "w") as f:
+        while f.tell() < target:
+            for _ in range(10000):
+                rec = {"user": rnd.randrange(10000),
+                       "tag": rnd.choice(words),
+                       "n": rnd.randrange(100)}
+                f.write(json.dumps(rec))
+                f.write("\n")
+                n += 1
+    return n, os.path.getsize(path)
+
+
+def pipeline(path):
+    return (Dampr.text(path)
+            .map(json.loads)
+            .map(lambda r: (r["tag"], r["n"]))
+            .filter(lambda kv: kv[1] % 100 < 80)
+            .fold_by(lambda kv: kv[0], binop=lambda a, b: a + b,
+                     value=lambda kv: kv[1]))
+
+
+def run_once(path, batch):
+    old = settings.batch_udf
+    settings.batch_udf = batch
+    try:
+        t0 = time.time()
+        out = dict(pipeline(path).run(name="batch_bench").read())
+        dt = time.time() - t0
+    finally:
+        settings.batch_udf = old
+    return dt, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=int, default=1024)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "data.jsonl")
+        print("generating %d MB of JSON lines..." % args.size_mb)
+        n, nbytes = make_input(path, args.size_mb)
+        print("records=%d bytes=%d" % (n, nbytes))
+
+        results = {}
+        for mode, batch in [("generator", False), ("batched", True)]:
+            dt, out = run_once(path, batch)
+            mbs = nbytes / dt / 1e6
+            results[mode] = (dt, mbs, out)
+            print("%-9s  %6.1fs  %7.1f MB/s" % (mode, dt, mbs))
+
+        assert results["generator"][2] == results["batched"][2], \
+            "outputs differ between lowerings!"
+        speedup = results["generator"][0] / results["batched"][0]
+        print(json.dumps({
+            "metric": "batch_udf_speedup",
+            "value": round(speedup, 3),
+            "unit": "x",
+            "generator_mb_s": round(results["generator"][1], 1),
+            "batched_mb_s": round(results["batched"][1], 1),
+            "size_mb": args.size_mb,
+        }))
+
+
+if __name__ == "__main__":
+    main()

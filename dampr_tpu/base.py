@@ -19,6 +19,8 @@ bugs (base.py:355, 366) and are not part of the public DSL; we implement the
 two exposed joins (inner/left) plus the map-side crosses.
 """
 
+import itertools
+
 import numpy as np
 
 from .ops import hashing, segment
@@ -278,12 +280,11 @@ class Filter(RecordOp):
         self.f = f
 
     def apply_batch(self, ks, vs):
-        f = self.f
-        sel = [bool(f(v)) for v in vs]
+        sel = list(map(self.f, vs))
         if all(sel):
             return ks, vs
-        return ([k for k, s in zip(ks, sel) if s],
-                [v for v, s in zip(vs, sel) if s])
+        return (list(itertools.compress(ks, sel)),
+                list(itertools.compress(vs, sel)))
 
     def stream(self, kvs):
         f = self.f
@@ -302,14 +303,16 @@ class FlatMap(RecordOp):
         self.f = f
 
     def apply_batch(self, ks, vs):
+        repeat = itertools.repeat
         f = self.f
         nks, nvs = [], []
         ext_k, ext_v = nks.extend, nvs.extend
         for k, v in zip(ks, vs):
             out = f(v)
-            out = out if isinstance(out, (list, tuple)) else list(out)
+            if not isinstance(out, (list, tuple)):
+                out = list(out)
             ext_v(out)
-            ext_k([k] * len(out))
+            ext_k(repeat(k, len(out)))
         return nks, nvs
 
     def stream(self, kvs):

@@ -66,6 +66,16 @@ def is_numeric(col):
     return col.dtype != object
 
 
+def pylist(col):
+    """Column -> plain-Python list.  One C-level tolist per lane; object
+    lanes get one extra pass unboxing stray numpy scalars, so consumers
+    (user binops, result readers) always see pure Python values."""
+    lst = col.tolist()
+    if col.dtype == object:
+        lst = [x.item() if isinstance(x, np.generic) else x for x in lst]
+    return lst
+
+
 class Block(object):
     __slots__ = ("keys", "values", "h1", "h2")
 
@@ -86,6 +96,13 @@ class Block(object):
         for i, (k, v) in enumerate(pairs):
             ks[i] = k
             vs[i] = v
+        return cls(_column_from_list(ks), _column_from_list(vs))
+
+    @classmethod
+    def from_lists(cls, ks, vs):
+        """Build a block from parallel key/value lists (the batched-UDF
+        path's native shape — no per-record tuple boxing)."""
+        assert len(ks) == len(vs)
         return cls(_column_from_list(ks), _column_from_list(vs))
 
     @classmethod
@@ -127,13 +144,28 @@ class Block(object):
         hb = 0 if self.h1 is None else self.h1.nbytes * 2
         return kb + vb + hb
 
-    def iter_pairs(self):
-        ks, vs = self.keys, self.values
-        for i in range(len(ks)):
-            k = ks[i]
-            v = vs[i]
-            yield (k.item() if isinstance(k, np.generic) else k,
-                   v.item() if isinstance(v, np.generic) else v)
+    def to_lists(self):
+        """Plain-Python parallel (keys, values) lists (see ``pylist``)."""
+        return pylist(self.keys), pylist(self.values)
+
+    def iter_pairs(self, _window=8192):
+        """Iterate (k, v) pairs with C-level lane conversion, materializing
+        at most ``_window`` boxed records at a time — the over-budget k-way
+        merge holds one in-flight iter_pairs per partition, so a full-block
+        tolist here would multiply the tight-memory path's footprint."""
+        n = len(self.keys)
+        if n <= _window:
+            kl, vl = self.to_lists()
+            return zip(kl, vl)
+
+        def gen():
+            for i in range(0, n, _window):
+                sub = Block(self.keys[i:i + _window],
+                            self.values[i:i + _window])
+                kl, vl = sub.to_lists()
+                yield from zip(kl, vl)
+
+        return gen()
 
     # -- hashing / routing -------------------------------------------------
     def hashes(self):

@@ -356,6 +356,24 @@ def hash_keys(keys):
     if n == 0:
         return (np.empty(0, dtype=np.uint32), np.empty(0, dtype=np.uint32))
 
+    # Homogeneity probe: one C-level pass over the exact types.  A block of
+    # all-str / in-range-int / plain-float keys — the overwhelmingly common
+    # case — skips the per-item _kind_of loop entirely.  Every branch routes
+    # into the same typed kernels (_hash_kind / _hash_float_array) the
+    # per-item path would pick, so hashes are identical by construction.
+    ts = set(map(type, keys))
+    if ts == {str} or ts == {bytes}:
+        return _hash_kind(_K_STR, keys)
+    if ts == {bool}:
+        return _mix_int(np.fromiter(keys, dtype=np.int64, count=n))
+    if ts == {int}:
+        try:
+            return _mix_int(np.fromiter(keys, dtype=np.int64, count=n))
+        except OverflowError:
+            pass  # out-of-int64 ints present: per-item classification
+    elif ts == {float}:
+        return _hash_float_array(np.fromiter(keys, dtype=np.float64, count=n))
+
     kinds = np.empty(n, dtype=np.int8)
     for i, k in enumerate(keys):
         kinds[i] = _kind_of(k)

@@ -400,18 +400,50 @@ def fold_sorted(groups, op):
             folded = ufunc.reduceat(vals, starts)
         return Block(keys, folded, kh1, kh2)
 
-    # host generic fold
+    # Host generic fold.  C-level lane conversion (pylist unboxes numpy
+    # scalars, so a user binop never sees an np.int64 that would wrap
+    # silently) happens in bounded windows — whole-lane boxing would
+    # multiply the footprint of near-budget partitions, the same
+    # discipline Block.iter_pairs applies.
+    from ..blocks import pylist
+
+    W = 65536
     out_vals = [None] * ng
-    vals = sb.values
     fn = op.fn
-    for i in range(ng):
-        acc = vals[starts[i]]
-        if isinstance(acc, np.generic):
-            acc = acc.item()
-        for j in range(starts[i] + 1, ends[i]):
-            v = vals[j]
-            acc = fn(acc, v.item() if isinstance(v, np.generic) else v)
-        out_vals[i] = acc
+    varr = sb.values
+    gi = 0
+    while gi < ng:
+        s0 = int(starts[gi])
+        e0 = int(ends[gi])
+        if e0 - s0 > W:
+            # One oversized group: fold it across bounded boxed windows,
+            # carrying the accumulator.
+            acc = None
+            first = True
+            for w0 in range(s0, e0, W):
+                it = iter(pylist(varr[w0:min(e0, w0 + W)]))
+                if first:
+                    acc = next(it)
+                    first = False
+                for v in it:
+                    acc = fn(acc, v)
+            out_vals[gi] = acc
+            gi += 1
+            continue
+        # A run of whole groups fitting one window: one conversion, tight
+        # per-group loops over local offsets.
+        ge = gi + 1
+        while ge < ng and int(ends[ge]) - s0 <= W:
+            ge += 1
+        win = pylist(varr[s0:int(ends[ge - 1])])
+        ls = (starts[gi:ge] - s0).tolist()
+        le = (ends[gi:ge] - s0).tolist()
+        for i in range(ge - gi):
+            acc = win[ls[i]]
+            for j in range(ls[i] + 1, le[i]):
+                acc = fn(acc, win[j])
+            out_vals[gi + i] = acc
+        gi = ge
     return Block(keys, _column_from_list(out_vals), kh1, kh2)
 
 

@@ -25,6 +25,7 @@ which SURVEY.md flags as a defect not to replicate).
 """
 
 import copy
+import itertools
 import logging
 import os
 import time
@@ -715,6 +716,14 @@ class MTRunner(object):
                             and type(mapper) is base.Map
                             and mapper.mapper is base._identity
                             and hasattr(chunk, "iter_blocks"))
+            # Batched-UDF path (SURVEY §7 hard part 1): a chain of pure
+            # RecordOps runs batch-at-a-time — read B records, run each
+            # op's apply_batch over the whole batch, build the block from
+            # the surviving lists.  Replaces the reference's per-record
+            # generator hot loop (ref stagerunner.py:73-74).
+            chain = (base.record_op_chain(mapper)
+                     if settings.batch_udf and not supplementary
+                     and not use_blocks and not ident_blocks else None)
             push, end = new_sink()
             if use_blocks:
                 for blk in mapper.map_blocks(chunk):
@@ -722,6 +731,67 @@ class MTRunner(object):
             elif ident_blocks:
                 for blk in chunk.iter_blocks():
                     push(blk)
+            elif chain is not None:
+                B = settings.batch_size
+                reader = getattr(chunk, "read_lists", None)
+                if reader is not None:
+                    batches = reader(B)
+                else:
+                    def _islice_batches(it=iter(chunk.read())):
+                        while True:
+                            ks, vs = [], []
+                            for k, v in itertools.islice(it, B):
+                                ks.append(k)
+                                vs.append(v)
+                            if not ks:
+                                return
+                            yield ks, vs
+                    batches = _islice_batches()
+                # Surviving records accumulate across input batches so a
+                # selective filter still emits ~B-record blocks (matching
+                # BlockBuilder's coalescing on the generator path), while
+                # FlatMap feeds in adaptive slices so B x fanout never
+                # materializes at once — memory stays bounded either way.
+                pk, pv = [], []
+
+                def emit(ks, vs):
+                    pk.extend(ks)
+                    pv.extend(vs)
+                    while len(pk) >= B:
+                        push(Block.from_lists(pk[:B], pv[:B]))
+                        del pk[:B]
+                        del pv[:B]
+
+                def run_chain(ks, vs, start):
+                    for i in range(start, len(chain)):
+                        op = chain[i]
+                        if type(op) is base.FlatMap and len(ks) > 1024:
+                            # Slice the expanding op's input, adapting to
+                            # its observed fanout so each slice's output
+                            # stays ~B; the rest of the chain runs per
+                            # slice.  Slices preserve stream order, so
+                            # batch/stream equivalence is unaffected.
+                            n = len(ks)
+                            at, step = 0, 1024
+                            while at < n:
+                                took = min(step, n - at)
+                                sks, svs = op.apply_batch(
+                                    ks[at:at + took], vs[at:at + took])
+                                at += took
+                                if sks:
+                                    fan = -(-len(sks) // took)
+                                    step = max(64, min(B, B // fan))
+                                    run_chain(sks, svs, i + 1)
+                            return
+                        ks, vs = op.apply_batch(ks, vs)
+                        if not ks:
+                            return
+                    emit(ks, vs)
+
+                for ks, vs in batches:
+                    run_chain(ks, vs, 0)
+                if pk:
+                    push(Block.from_lists(pk, pv))
             else:
                 kvs = (mapper.map(chunk, *supplementary) if supplementary
                        else mapper.map(chunk))

@@ -40,6 +40,14 @@ max_files_per_stage = 50
 #: dispatch).
 batch_size = 65536
 
+#: Execute pure per-record op chains (RecordOps) batch-at-a-time via
+#: ``apply_batch`` — one tight C-level loop per op per batch — instead of
+#: threading every record through nested generator frames (the reference's
+#: hot loop, stagerunner.py:73-74).  Off = the record-at-a-time generator
+#: lowering; outputs are identical (tests pin it), this is purely the
+#: execution strategy.
+batch_udf = os.environ.get("DAMPR_TPU_BATCH_UDF", "1") not in ("0", "false")
+
 #: Byte budget per stage for in-memory blocks before spilling to the next tier
 #: (replaces the reference's RSS-watermark `max_memory_per_worker`=512MB,
 #: settings.py:27 + memory.py — our block sizes are known, so accounting is

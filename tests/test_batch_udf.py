@@ -1,0 +1,247 @@
+"""Batched-UDF execution: ``apply_batch`` must be record-for-record
+equivalent to the ``stream`` lowering for every RecordOp, and the runner's
+batch path must produce identical pipeline results to the generator path.
+
+SURVEY §7 hard part 1 (batched host execution for opaque lambdas); the loop
+being replaced is the reference's per-record generator chain
+(ref stagerunner.py:73-74).
+"""
+
+import random
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.base import (Filter, FlatMap, Inspect, MapKeys, MapValues,
+                            Prefix, Rekey, Sample, Suffix, ValueMap,
+                            record_op_chain)
+
+
+def _run_both(op, records):
+    """Run one op's stream and batch lowerings over the same records."""
+    streamed = list(op.stream(iter(records)))
+    ks = [k for k, _ in records]
+    vs = [v for _, v in records]
+    bks, bvs = op.apply_batch(list(ks), list(vs))
+    return streamed, list(zip(bks, bvs))
+
+
+RECORDS = [(i, (i % 5, i * 2)) for i in range(200)]
+FLAT_RECORDS = [(i, i) for i in range(200)]
+
+
+class TestOpEquivalence:
+    """batch ≡ stream, op by op, including output order."""
+
+    @pytest.mark.parametrize("op,records", [
+        (ValueMap(lambda v: (v[0], v[1] + 1)), RECORDS),
+        (MapValues(lambda b: b * 10), RECORDS),
+        (MapKeys(lambda a: a - 1), RECORDS),
+        (Prefix(lambda v: v[0]), RECORDS),
+        (Suffix(lambda v: v[1]), RECORDS),
+        (Filter(lambda v: v[1] % 3 == 0), RECORDS),
+        (Filter(lambda v: False), RECORDS),
+        (Filter(lambda v: True), RECORDS),
+        (FlatMap(lambda v: [v, v, v]), FLAT_RECORDS),
+        (FlatMap(lambda v: []), FLAT_RECORDS),
+        (FlatMap(lambda v: (x for x in range(v % 4))), FLAT_RECORDS),
+        (Rekey(lambda v: v[0]), RECORDS),
+        (Rekey(lambda v: v[0], lambda v: v[1]), RECORDS),
+        (Inspect("t"), FLAT_RECORDS[:3]),
+    ])
+    def test_batch_equals_stream(self, op, records):
+        streamed, batched = _run_both(op, records)
+        assert streamed == batched
+
+    def test_sample_rng_sequence_identity(self):
+        # Both lowerings must consume the identical random sequence: same
+        # seed => same records selected, in the same order.
+        def factory():
+            return random.Random(1234)
+
+        op = Sample(0.4, factory)
+        streamed, batched = _run_both(op, FLAT_RECORDS)
+        assert streamed == batched
+        assert 30 < len(streamed) < 130  # actually sampled, not all/none
+
+    def test_stateful_filter_sees_stream_order(self):
+        # A self-contained stateful UDF (dedupe seen-set) must observe
+        # records in the same order under both lowerings.
+        def run(lowering):
+            seen = set()
+
+            def dedupe(v):
+                if v in seen:
+                    return False
+                seen.add(v)
+                return True
+
+            op = Filter(dedupe)
+            records = [(i, i % 7) for i in range(50)]
+            if lowering == "stream":
+                return list(op.stream(iter(records)))
+            ks, vs = op.apply_batch([k for k, _ in records],
+                                    [v for _, v in records])
+            return list(zip(ks, vs))
+
+        assert run("stream") == run("batch")
+        assert [v for _, v in run("stream")] == list(range(7))
+
+
+class TestChainFlattening:
+    def test_chain_extracted_from_fused_pipeline(self):
+        from dampr_tpu.base import fuse
+
+        ops = [ValueMap(lambda v: v + 1), Filter(lambda v: v % 2 == 0),
+               FlatMap(lambda v: [v, -v])]
+        fused = fuse(ops)
+        chain = record_op_chain(fused)
+        assert chain is not None and len(chain) == 3
+
+    def test_opaque_mapper_defeats_chain(self):
+        from dampr_tpu.base import Map, fuse
+
+        ops = [ValueMap(lambda v: v + 1), Map(lambda k, v: [(k, v)])]
+        assert record_op_chain(fuse(ops)) is None
+
+
+class TestPipelineEquivalence:
+    """End-to-end: batch_udf on/off produce identical results."""
+
+    def _pipeline(self, data):
+        return (Dampr.memory(data)
+                .map(lambda x: x * 3)
+                .filter(lambda x: x % 2 == 0)
+                .flat_map(lambda x: [x, x + 1])
+                .map(lambda x: x - 1))
+
+    def _fold_pipeline(self, data):
+        return (Dampr.memory(data)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 10, binop=lambda a, b: a + b))
+
+    @pytest.mark.parametrize("maker", ["_pipeline", "_fold_pipeline"])
+    def test_on_off_identical(self, maker):
+        data = list(range(3000))
+        old = settings.batch_udf
+        try:
+            settings.batch_udf = True
+            on = sorted(getattr(self, maker)(data).run().read())
+            settings.batch_udf = False
+            off = sorted(getattr(self, maker)(data).run().read())
+        finally:
+            settings.batch_udf = old
+        assert on == off
+
+    def test_batch_path_is_taken(self, monkeypatch):
+        # Guard against the path silently unwiring again (round-4 bug):
+        # assert apply_batch actually runs during a plain .map pipeline.
+        calls = []
+        orig = ValueMap.apply_batch
+
+        def spy(self, ks, vs):
+            calls.append(len(ks))
+            return orig(self, ks, vs)
+
+        monkeypatch.setattr(ValueMap, "apply_batch", spy)
+        old = settings.batch_udf
+        try:
+            settings.batch_udf = True
+            out = Dampr.memory(list(range(100))).map(lambda x: x + 1).run()
+            assert sorted(out.read()) == list(range(1, 101))
+        finally:
+            settings.batch_udf = old
+        assert calls and sum(calls) == 100
+
+    def test_flatmap_ordering_within_partition(self):
+        # FlatMap expansion order must survive the batch path: each key's
+        # emitted elements stay contiguous and ordered.
+        old = settings.batch_udf
+        try:
+            settings.batch_udf = True
+            out = (Dampr.memory([5])
+                   .flat_map(lambda x: list(range(x)))
+                   .run())
+            assert list(v for v in out.read()) == [0, 1, 2, 3, 4]
+        finally:
+            settings.batch_udf = old
+
+
+class TestReadLists:
+    """read_lists must yield exactly read()'s records for every chunk
+    boundary placement (the chunk-ownership contract)."""
+
+    def test_equivalence_across_boundaries(self, tmp_path):
+        from dampr_tpu.dataset import TextLineDataset
+
+        p = tmp_path / "t.txt"
+        lines = ["line %d %s" % (i, "x" * (i % 13)) for i in range(500)]
+        p.write_text("\n".join(lines) + ("\n" if True else ""))
+        size = p.stat().st_size
+        # sweep chunk boundaries, including mid-line and exact-newline cuts
+        for cut in [0, 1, 7, size // 3, size // 2, size - 2, size]:
+            a = TextLineDataset(str(p), 0, cut)
+            b = TextLineDataset(str(p), cut, None)
+            got = []
+            for ds in (a, b):
+                for ks, vs in ds.read_lists(64):
+                    got.extend(zip(ks, vs))
+            want = list(a.read()) + list(b.read())
+            assert got == want, "cut=%d" % cut
+
+    def test_no_trailing_newline(self, tmp_path):
+        from dampr_tpu.dataset import TextLineDataset
+
+        p = tmp_path / "t.txt"
+        p.write_bytes(b"alpha\nbeta\ngamma")  # no trailing newline
+        ds = TextLineDataset(str(p))
+        got = [kv for ks, vs in ds.read_lists(2) for kv in zip(ks, vs)]
+        assert got == list(ds.read())
+
+    def test_empty_file(self, tmp_path):
+        from dampr_tpu.dataset import TextLineDataset
+
+        p = tmp_path / "t.txt"
+        p.write_bytes(b"")
+        assert list(TextLineDataset(str(p)).read_lists(8)) == []
+
+
+class TestObjectLaneFolds:
+    def test_huge_numpy_ints_fold_exactly(self):
+        # Object value lanes holding numpy scalars must normalize to Python
+        # values before reaching an opaque user binop (np.int64 would wrap).
+        import numpy as np
+
+        out = (Dampr.memory([0, 1])
+               .map(lambda x: np.int64(2 ** 62))
+               .fold_by(lambda v: "k", binop=lambda a, b: a + b))
+        assert dict(out.read()) == {"k": 2 ** 63}
+
+    def test_selective_filter_coalesces_blocks(self):
+        # 0.4% selectivity over many batches: outputs must still be exact
+        # (and internally coalesce, not register thousands of tiny blocks).
+        old = settings.batch_udf
+        try:
+            settings.batch_udf = True
+            out = (Dampr.memory(list(range(200_000)), partitions=2)
+                   .filter(lambda x: x % 250 == 0)
+                   .run())
+            assert sorted(out.read()) == list(range(0, 200_000, 250))
+        finally:
+            settings.batch_udf = old
+
+    def test_high_fanout_flatmap_sliced(self):
+        # Fanout ~200 forces the adaptive FlatMap slicing path; results
+        # must stay exact and ordered per key.
+        old = settings.batch_udf
+        try:
+            settings.batch_udf = True
+            out = (Dampr.memory(list(range(5000)), partitions=1)
+                   .flat_map(lambda x: [x] * 200)
+                   .fold_by(lambda x: x % 2, binop=lambda a, b: a + b))
+            got = dict(out.read())
+            want0 = sum(x * 200 for x in range(0, 5000, 2))
+            want1 = sum(x * 200 for x in range(1, 5000, 2))
+            assert got == {0: want0, 1: want1}
+        finally:
+            settings.batch_udf = old
