@@ -374,6 +374,36 @@ def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
         capacity *= 2
 
 
+def mesh_keyed_fold_dev(mesh, h1, h2, v, kind, nonneg=False,
+                        capacity_factor=None):
+    """Device-resident window fold: like ``mesh_keyed_fold(raw=True)`` but
+    the inputs are ALREADY jax arrays (the HBM storage tier's block lanes),
+    so padding happens with jnp and no host copy occurs in either
+    direction.  Lane safety is the CALLER's contract (the storage tier
+    verified the value lane at registration, where the host array still
+    existed); ``nonneg`` likewise comes from registration-time metadata.
+    Returns the padded ``(h1, h2, v, ok)`` partials, device-resident."""
+    import jax.numpy as jnp
+
+    n_dev = mesh_size(mesh)
+    total = h1.shape[0]
+    if total == 0:
+        z = jnp.zeros(0, jnp.uint32)
+        return z, z, v[:0], z
+    n_local = _pad_pow2(-(-total // n_dev))
+    padded = n_local * n_dev
+    valid = jnp.ones(total, dtype=jnp.uint32)
+    if padded != total:
+        pad = padded - total
+        h1 = jnp.pad(h1, (0, pad))
+        h2 = jnp.pad(h2, (0, pad))
+        v = jnp.pad(v, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    factor = capacity_factor or settings.shuffle_capacity_factor
+    return _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind,
+                            nonneg, factor)
+
+
 def mesh_keyed_refold(mesh, parts, kind, nonneg=False, capacity_factor=None):
     """Re-fold device-resident partials from ``mesh_keyed_fold(raw=True)``.
 

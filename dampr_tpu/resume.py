@@ -386,14 +386,18 @@ def _ensure_on_disk(ref, directory):
         with open(path, "wb") as f:
             f.write(ref._packed)  # gzip'd single-window stream: the spill
             # wire format readers already sniff and stream
-        return path
+        return path, ref.nbytes
     if ref.path is None:
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, uuid.uuid4().hex + ".blk")
-        save_block(ref._block, path)
+        # get() covers every residency: RAM blocks return as-is;
+        # HBM-resident refs materialize via one counted value-lane fetch
+        # (their device copy stays live for the consuming reduce).
+        blk = ref.get()
+        save_block(blk, path)
         ref.path = path
-        return path
-    return ref.path
+        return path, blk.nbytes()
+    return ref.path, ref.nbytes
 
 
 def persist_stage(store, sid, fp, result, nrec):
@@ -413,9 +417,9 @@ def persist_stage(store, sid, fp, result, nrec):
         blocks = []
         for pid in sorted(result.parts):
             for ref in result.parts[pid]:
-                path = _ensure_on_disk(ref, directory)
+                path, nbytes = _ensure_on_disk(ref, directory)
                 blocks.append([pid, os.path.relpath(path, root),
-                               ref.nrecords, int(ref.nbytes),
+                               ref.nrecords, int(nbytes),
                                str(ref.key_dtype), str(ref.value_dtype)])
         manifest = {"fp": fp, "kind": "pset",
                     "n_partitions": result.n_partitions,

@@ -535,16 +535,18 @@ class MTRunner(object):
             if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
                 chunks = [BlockDataset(refs)]
 
-        job, combine_op, pin, feeds_reduce, _new_sink = self._map_job_factory(
+        (job, combine_op, pin, feeds_reduce, _new_sink,
+         feeds_dev) = self._map_job_factory(
             stage, supplementary)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
         results = self._pool_run(job, chunks, n_maps)
         pset = self._collect_partitions(results, combine_op, pin,
-                                        feeds_reduce)
+                                        feeds_reduce, device=feeds_dev)
         return pset, pset.total_records(), len(chunks)
 
-    def _collect_partitions(self, mappings, combine_op, pin, feeds_reduce):
+    def _collect_partitions(self, mappings, combine_op, pin, feeds_reduce,
+                            device=False):
         """Assemble per-chunk {pid: [refs]} job results into one compacted
         PartitionSet (shared by run_map and run_map_group)."""
         pset = storage.PartitionSet(self.n_partitions)
@@ -552,7 +554,8 @@ class MTRunner(object):
             for pid, refs in mapping.items():
                 for ref in refs:
                     pset.add(pid, ref)
-        self._compact_partitions(pset, combine_op, pin, feeds_reduce)
+        self._compact_partitions(pset, combine_op, pin, feeds_reduce,
+                                 device=device)
         return pset
 
     def _scan_share_group(self, sid, stage, env):
@@ -629,9 +632,11 @@ class MTRunner(object):
 
         ret = []
         for i in range(len(stages)):
-            _job, combine_op, pin, feeds_reduce, _new_sink = factories[i]
+            (_job, combine_op, pin, feeds_reduce, _new_sink,
+             feeds_dev) = factories[i]
             pset = self._collect_partitions(
-                [outs[i] for outs in results], combine_op, pin, feeds_reduce)
+                [outs[i] for outs in results], combine_op, pin, feeds_reduce,
+                device=feeds_dev)
             ret.append((pset, pset.total_records(), len(chunks)))
         log.info("scan sharing: %d stages fused over one pass of %d chunks",
                  len(stages), len(chunks))
@@ -656,6 +661,22 @@ class MTRunner(object):
         feeds_reduce = any(
             isinstance(s, GReduce) and stage.output in s.inputs
             for s in self.graph.stages)
+        # HBM residency: outputs consumed by a device-foldable reduce keep
+        # their numeric value lanes on device (storage register gates on
+        # the lane whitelist + budget), so the map->reduce boundary never
+        # round-trips those lanes through host memory.
+        feeds_device_fold = (
+            feeds_reduce
+            and settings.use_device
+            and str(settings.mesh_fold).lower() not in ("off", "0", "false")
+            and any(
+                isinstance(s, GReduce) and stage.output in s.inputs
+                and len(s.inputs) == 1
+                and isinstance(getattr(s, "reducer", None),
+                               base.AssocFoldReducer)
+                and getattr(getattr(s.reducer, "op", None), "kind", None)
+                in ("sum", "min", "max")
+                for s in self.graph.stages))
 
         def new_sink():
             """Push-mode accumulator for one chunk job: push(blk) folds/
@@ -695,7 +716,8 @@ class MTRunner(object):
                         blk = blk.sort_by_hash()
                     for pid, sub in blk.split_by_partition(P).items():
                         out.setdefault(pid, []).append(
-                            self.store.register(sub, pin=pin))
+                            self.store.register(sub, pin=pin,
+                                                device=feeds_device_fold))
                 return out
 
             return push, end
@@ -800,9 +822,10 @@ class MTRunner(object):
                 push(builder.flush())
             return end()
 
-        return job, combine_op, pin, feeds_reduce, new_sink
+        return job, combine_op, pin, feeds_reduce, new_sink, feeds_device_fold
 
-    def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True):
+    def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True,
+                            device=False):
         """Block-count governor (the reference's file-count combiner rounds,
         runner.py:293-320): partitions holding more than max_files_per_stage
         refs merge — re-folding under the stage's associative op when present
@@ -833,7 +856,8 @@ class MTRunner(object):
                         # keep the run invariant: merged blocks stay
                         # hash-sorted so streaming reduces can merge them
                         merged = merged.sort_by_hash()
-                    merged_refs.append(self.store.register(merged, pin=pin))
+                    merged_refs.append(self.store.register(
+                        merged, pin=pin, device=device))
                 refs = merged_refs
             pset.parts[pid] = refs
 
@@ -858,12 +882,17 @@ class MTRunner(object):
         op = stage.reducer.op
         if op.kind not in ("sum", "min", "max"):
             return None
+        refs = list(entries[0].all_refs())
         if (mode not in ("on", "1", "true")
-                and settings.device_count_for_auto() < 2):
+                and settings.device_count_for_auto() < 2
+                and not any(getattr(r, "is_device", False) for r in refs)):
+            # Single device and nothing HBM-resident: the local fold path
+            # is cheaper.  With device-resident inputs the mesh fold (D=1
+            # degenerates to the plain collective program) IS the consumer
+            # that keeps the value lanes from round-tripping through host.
             return None
         import jax
 
-        refs = list(entries[0].all_refs())
         if not refs:
             return storage.PartitionSet(self.n_partitions), 0, 1
         # Cheap metadata check before touching any (possibly spilled) data.
@@ -897,14 +926,14 @@ class MTRunner(object):
                 return bool(np.all(a == b))
             return all(x == y for x, y in zip(a, b))
 
-        def merge_table(blk, h1, h2):
+        def merge_table(keys, h1, h2):
             """Fold the window's (hash -> key) pairs into the sorted table —
             sort only the window, then a linear searchsorted+insert merge —
             verifying equal 64-bit hashes always carry equal keys."""
             u = combine64(h1, h2)
             worder = np.argsort(u, kind="stable")
             su = u[worder]
-            sk = np.asarray(blk.keys).take(worder)
+            sk = np.asarray(keys).take(worder)
             # In-window dedup with the collision check on adjacent dups.
             first = np.empty(len(su), dtype=bool)
             first[0] = True
@@ -996,7 +1025,7 @@ class MTRunner(object):
             else:
                 acc["nonneg"] = False
             h1, h2 = blk.hashes()
-            merge_table(blk, h1, h2)
+            merge_table(blk.keys, h1, h2)
             try:
                 f = mesh_keyed_fold(mesh, h1, h2, vals, op.kind, raw=True)
             except ValueError:
@@ -1009,9 +1038,70 @@ class MTRunner(object):
             if len(partials) >= _PARTIAL_FANIN:
                 compact()
 
+        _I32 = 2 ** 31 - 1
+        _I64 = 2 ** 63 - 1
+
+        def flush_dev(ref):
+            """Fold one HBM-resident block without any host lane copy: the
+            device lanes go straight into the collective fold program; the
+            exact-key table merges from the ref's HOST-side metadata (keys
+            + hashes kept at registration); overflow/nonneg bookkeeping
+            uses the registration-time lane_abs/lane_min numbers — the
+            same math flush() runs on host values, sourced where the host
+            array last existed."""
+            from .parallel.shuffle import mesh_keyed_fold_dev
+
+            dv, dh1, dh2 = ref.device_lanes()
+            keys, h1, h2 = ref.host_meta()
+            lane_dt = np.dtype(dv.dtype)
+            nonneg = False
+            if lane_dt.kind in "iu":
+                acc["lane_max"] = min(acc["lane_max"],
+                                      int(np.iinfo(lane_dt).max))
+                if op.kind == "sum":
+                    if x64:
+                        acc["abs"] += float(ref.lane_abs) * (1 + 1e-6) + 1
+                    else:
+                        acc["abs"] += int(ref.lane_abs)
+                    if acc["abs"] > acc["lane_max"]:
+                        raise _HostPath  # cross-window overflow: host exact
+                if acc["nonneg"] and (lane_dt.kind != "i"
+                                      or ref.lane_min < 0):
+                    acc["nonneg"] = False
+                # Per-window scan-lowering eligibility (mirrors
+                # mesh_keyed_fold's own nonneg gate, from stored metadata).
+                if (op.kind == "sum" and lane_dt.kind == "i"
+                        and ref.lane_min >= 0):
+                    # x64 lane_abs is a float64 estimate: apply the same
+                    # margin flush() uses so a sum one ulp past the lane
+                    # bound can never wrongly qualify for the scan lowering.
+                    if lane_dt == np.int32:
+                        nonneg = (True if not x64
+                                  else ref.lane_abs * (1 + 1e-6) + 1 <= _I32)
+                    elif lane_dt == np.int64:
+                        nonneg = ref.lane_abs * (1 + 1e-6) + 1 <= _I64
+            else:
+                acc["nonneg"] = False
+            merge_table(keys, h1, h2)
+            f = mesh_keyed_fold_dev(mesh, dh1, dh2, dv, op.kind,
+                                    nonneg=nonneg)
+            if acc["dtype"] is None:
+                acc["dtype"] = f[2].dtype
+            elif f[2].dtype != acc["dtype"]:
+                raise _HostPath  # mixed lane dtypes across windows
+            partials.append(f)
+            if len(partials) >= _PARTIAL_FANIN:
+                compact()
+
         try:
             win, wbytes = [], 0
+            dev_folds = 0
             for ref in refs:
+                if getattr(ref, "is_device", False) and len(ref):
+                    # HBM-resident map output: fold it where it lives.
+                    flush_dev(ref)
+                    dev_folds += 1
+                    continue
                 for w in ref.iter_windows():
                     if not len(w):
                         continue
@@ -1022,6 +1112,9 @@ class MTRunner(object):
                         win, wbytes = [], 0
             if win:
                 flush(win)
+            if dev_folds:
+                log.info("mesh fold: %d HBM-resident blocks consumed "
+                         "on-device", dev_folds)
             if not partials:
                 return storage.PartitionSet(self.n_partitions), 0, 1
             if len(partials) > 1:
@@ -1514,6 +1607,14 @@ class MTRunner(object):
             st.seconds = time.time() - t0
             self.stats.append(st)
             log.info("Stage %s done: %s", sid + 1, st.as_dict())
+
+        sto = self.store
+        if sto.h2d_bytes or sto.d2h_bytes or sto.hbm_offloads:
+            log.info(
+                "HBM tier: %d bytes up, %d bytes fetched back, %d offloads, "
+                "peak residency %d bytes",
+                sto.h2d_bytes, sto.d2h_bytes, sto.hbm_offloads,
+                sto.hbm_peak_bytes)
 
         ret = []
         keep = set()

@@ -158,6 +158,42 @@ def use_device_for(n):
         return False
     return n >= effective_device_min_batch()
 
+#: HBM residency tier budget, in bytes (SURVEY §2 item 6 / §7 sketch 1):
+#: numeric VALUE lanes of reduce-feeding stage outputs stay device-resident
+#: between map and reduce — the reduce's collective fold consumes them
+#: without a host round-trip.  Over budget, the oldest device refs offload
+#: device->host (the FIRST spill step; host RAM pressure then cascades to
+#: disk as usual).  0 disables the tier.  "auto" resolves by transport the
+#: same way device_min_batch does: off on cpu backends (device RAM is host
+#: RAM) and on tunnel-attached hosts (a hung tunnel must never wedge the
+#: engine), 1 GB on a locally-attached accelerator.
+hbm_budget = os.environ.get("DAMPR_TPU_HBM_BUDGET", "auto")
+
+#: Minimum records in a block before HBM residency is worth the put
+#: overhead; smaller reduce-feeding blocks stay host (the local fold is
+#: cheaper than a device round-trip at that size).
+hbm_min_records = int(os.environ.get("DAMPR_TPU_HBM_MIN_RECORDS", "4096"))
+
+_resolved_hbm = None
+
+
+def effective_hbm_budget():
+    global _resolved_hbm
+    if isinstance(hbm_budget, int):
+        return hbm_budget
+    s = str(hbm_budget).lower()
+    if s != "auto":
+        return int(s)
+    if _resolved_hbm is None:
+        if os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+            _resolved_hbm = 0
+        else:
+            import jax
+
+            _resolved_hbm = 0 if jax.default_backend() == "cpu" else 1 << 30
+    return _resolved_hbm
+
+
 #: Capacity slack factor for the fixed-shape all_to_all shuffle exchange
 #: (MoE-style capacity: per-(src,dst) buffer = ceil(N/D) * factor).
 shuffle_capacity_factor = 1.5

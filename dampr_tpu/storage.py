@@ -3,9 +3,11 @@
 Replaces the reference's disk-spill machinery — RSS-watermark writers
 (dampr/dataset.py:119-262, memory.py) and the /tmp/<job>/stage_N scratch tree
 (base.py:435-469) — with deterministic byte accounting: block sizes are known
-exactly, so no /proc sampling is needed.  The tier order is RAM → disk
-(HBM-resident arrays are transient inside kernels; host RAM is the working
-tier, gzip'd pickle files the spill tier).
+exactly, so no /proc sampling is needed.  The tier order is HBM → RAM → disk:
+numeric value lanes of reduce-feeding stage outputs stay device-resident
+under ``settings.hbm_budget`` (the reduce's collective fold consumes them
+in place — no host round-trip at the map→reduce boundary); device→host
+offload is the first spill step, gzip'd pickle files on disk the second.
 
 Every stage output lives behind :class:`BlockRef`; the per-run
 :class:`RunStore` decides which refs stay hot.  ``pin=True`` refs (``cached()``
@@ -21,20 +23,35 @@ import shutil
 import threading
 import uuid
 
+import numpy as np
+
 from . import settings
 
 log = logging.getLogger("dampr_tpu.storage")
 
+_I32_MAX = 2 ** 31 - 1
+
 
 class BlockRef(object):
-    """A handle to one materialized block: RAM-resident, compressed-in-RAM
-    (pinned ``cached()`` blocks — the reference's MemGZipDataset tier,
-    dampr/dataset.py:528-547), or spilled to disk."""
+    """A handle to one materialized block: HBM-resident (numeric value lane
+    on device — the tier the reference never had), RAM-resident,
+    compressed-in-RAM (pinned ``cached()`` blocks — the reference's
+    MemGZipDataset tier, dampr/dataset.py:528-547), or spilled to disk.
+
+    Device residency model: the VALUE lane and both hash lanes live on
+    device (what the reduce-side collective fold consumes); keys and the
+    hash lanes ALSO stay host-side as ``_kmeta`` (partition routing and the
+    exact-key table are host metadata by design), so a device-fold reduce
+    touches the value lane without any host copy in either direction.
+    ``lane_abs``/``lane_min`` carry the registration-time exactness
+    metadata the cross-window overflow accounting needs (computed where the
+    host array still existed — no device fetch ever required for it)."""
 
     __slots__ = ("_block", "_packed", "path", "nbytes", "nrecords",
-                 "value_dtype", "key_dtype", "store", "pin")
+                 "value_dtype", "key_dtype", "store", "pin",
+                 "_dev", "_kmeta", "dev_bytes", "lane_abs", "lane_min")
 
-    def __init__(self, block, store=None, pin=False):
+    def __init__(self, block, store=None, pin=False, device_prep=None):
         self._packed = None
         self.nrecords = len(block)
         self.value_dtype = block.values.dtype  # metadata survives spilling
@@ -42,15 +59,104 @@ class BlockRef(object):
         self.store = store
         self.pin = pin
         self.path = None
+        self._dev = None
+        self._kmeta = None
+        self.dev_bytes = 0
+        self.lane_abs = None
+        self.lane_min = None
         if pin:
             # cached() semantics: compressed RAM, charged at compressed size
             # (never spilled to disk, decompressed per read).
             self._block = None
             self._packed = pack_block(block)
             self.nbytes = len(self._packed)
+        elif device_prep is not None:
+            self._put_device(block, device_prep)
         else:
             self._block = block
             self.nbytes = block.nbytes()
+
+    # -- HBM tier ----------------------------------------------------------
+    @staticmethod
+    def lane_prep(values, kind_hint="sum"):
+        """One pass over a value lane deciding device eligibility AND
+        producing everything _put_device needs: returns None (ineligible —
+        mirrors parallel.shuffle._lane_safe_values' whitelist, so a
+        device-tiered block can never hit the fold's refusal path at reduce
+        time) or ``(lane_vals, lane_abs, lane_min)``."""
+        import jax
+
+        x64 = jax.config.jax_enable_x64
+        dt = values.dtype
+        if dt == object or dt == np.uint64 or (
+                dt == np.float64 and not x64):
+            return None
+        if dt.kind == "f":
+            if dt == np.float16:
+                return values.astype(np.float32), None, None
+            return values, None, None
+        if dt == np.bool_ or dt.kind in "iu":
+            v64 = values.astype(np.int64)
+            if not len(v64):
+                return (v64 if x64 else v64.astype(np.int32)), 0, 0
+            lo, hi = int(v64.min()), int(v64.max())
+            if x64:
+                # Unbounded int64 lane: a float64 abs-sum over-estimate
+                # (margin applied at use) — np.abs on raw int64 could wrap
+                # at int64 min.
+                lane_abs = float(np.abs(v64.astype(np.float64)).sum())
+                return v64, lane_abs, lo
+            if lo < -_I32_MAX - 1 or hi > _I32_MAX:
+                return None
+            lane_abs = int(np.abs(v64).sum())
+            if kind_hint == "sum" and lane_abs > _I32_MAX:
+                return None
+            return v64.astype(np.int32), lane_abs, lo
+        return None
+
+    def _put_device(self, block, prep):
+        """Move the value lane (cast to its exact device lane dtype by
+        lane_prep) and hash lanes to device; keys + hashes stay host as
+        routing metadata."""
+        import jax
+
+        h1, h2 = block.hashes()
+        lane_vals, self.lane_abs, self.lane_min = prep
+        self._dev = (jax.device_put(lane_vals), jax.device_put(h1),
+                     jax.device_put(h2))
+        self.dev_bytes = lane_vals.nbytes + h1.nbytes + h2.nbytes
+        self._kmeta = (block.keys, h1, h2)
+        self._block = None
+        # Host budget is charged for what stays host-resident.
+        self.nbytes = block.keys.nbytes + h1.nbytes + h2.nbytes
+
+    @property
+    def is_device(self):
+        return self._dev is not None
+
+    def device_lanes(self):
+        """(values, h1, h2) jax arrays — the reduce-side fold's input."""
+        return self._dev
+
+    def host_meta(self):
+        """(keys, h1, h2) host arrays (routing / exact-key table)."""
+        return self._kmeta
+
+    def offload(self):
+        """Device -> host: the HBM tier's first spill step.  Returns
+        (freed_dev_bytes, host_bytes_delta); the caller re-enters this ref
+        into host accounting."""
+        if self._dev is None:  # raced with a concurrent drop
+            return 0, 0
+        blk = self.get()  # one counted device fetch of the value lane
+        freed = self.dev_bytes
+        old_host = self.nbytes
+        self._dev = None
+        self._kmeta = None
+        self.dev_bytes = 0
+        self._block = blk
+        self.nbytes = blk.nbytes()
+        return freed, self.nbytes - old_host
 
     @classmethod
     def from_disk(cls, path, nrecords, nbytes, key_dtype, value_dtype):
@@ -68,6 +174,11 @@ class BlockRef(object):
         ref.value_dtype = np.dtype(value_dtype)
         ref.store = None
         ref.pin = False
+        ref._dev = None
+        ref._kmeta = None
+        ref.dev_bytes = 0
+        ref.lane_abs = None
+        ref.lane_min = None
         return ref
 
     def __len__(self):
@@ -80,6 +191,18 @@ class BlockRef(object):
     def get(self):
         blk = self._block
         if blk is None:
+            if self._dev is not None:
+                # Host materialization of a device-resident block: one
+                # value-lane fetch (counted — the HBM tier's whole point is
+                # that device-fold reduces never take this path).
+                vals = np.asarray(self._dev[0]).astype(
+                    self.value_dtype, copy=False)
+                if self.store is not None:
+                    self.store.count_d2h(vals.nbytes)
+                keys, h1, h2 = self._kmeta
+                from .blocks import Block
+
+                return Block(keys, vals, h1, h2)
             if self._packed is not None:
                 return unpack_block(self._packed)
             blk = load_block(self.path)
@@ -92,11 +215,14 @@ class BlockRef(object):
         whole (resident blocks yield array-view slices)."""
         blk = self._block
         if blk is None:
-            if self._packed is None:
+            if self._dev is not None:
+                blk = self.get()
+            elif self._packed is None:
                 for w in iter_block_windows(self.path):
                     yield w
                 return
-            blk = unpack_block(self._packed)
+            else:
+                blk = unpack_block(self._packed)
         from .blocks import Block
 
         n = len(blk)
@@ -123,6 +249,9 @@ class BlockRef(object):
     def delete(self):
         self._block = None
         self._packed = None
+        self._dev = None
+        self._kmeta = None
+        self.dev_bytes = 0
         if self.path and os.path.exists(self.path):
             os.unlink(self.path)
             self.path = None
@@ -222,10 +351,26 @@ class RunStore(object):
         self._lock = threading.Lock()
         self._resident = []          # FIFO of RAM refs
         self._resident_bytes = 0
+        self._dev_resident = []      # FIFO of HBM refs
+        self._dev_bytes = 0
         self._stage = "stage_0"
         self._attempts = threading.local()
         self.spill_count = 0
         self.spilled_bytes = 0
+        # HBM tier stats: the boundary evidence (h2d at registration,
+        # offloads + d2h fetches after — a device-fold reduce adds zero to
+        # d2h_bytes for the lanes it consumed).
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.hbm_offloads = 0
+        self.hbm_peak_bytes = 0
+
+    def count_d2h(self, n):
+        with self._lock:
+            self.d2h_bytes += n
+
+    def hbm_budget(self):
+        return settings.effective_hbm_budget()
 
     @contextlib.contextmanager
     def attempt(self):
@@ -249,41 +394,111 @@ class RunStore(object):
     def set_stage(self, stage_name):
         self._stage = "stage_{}".format(stage_name)
 
-    def register(self, block, pin=False):
-        ref = BlockRef(block, store=self, pin=pin)
+    def register(self, block, pin=False, device=False):
+        prep = None
+        if (device and not pin and settings.use_device
+                and self.hbm_budget() > 0
+                and len(block) >= settings.hbm_min_records):
+            prep = BlockRef.lane_prep(block.values)
+        ref = BlockRef(block, store=self, pin=pin, device_prep=prep)
         stack = getattr(self._attempts, "stack", None)
         if stack:
             stack[-1].append(ref)
+        dev_victims = []
         with self._lock:
+            if ref.is_device:
+                self._dev_resident.append(ref)
+                self._dev_bytes += ref.dev_bytes
+                self.h2d_bytes += ref.dev_bytes
+                self.hbm_peak_bytes = max(self.hbm_peak_bytes,
+                                          self._dev_bytes)
+                dev_victims = self._select_dev_victims_locked()
+            # Host accounting charges what stays host-side (full block, or
+            # keys+hashes for a device-tiered ref).
             self._resident.append(ref)
             self._resident_bytes += ref.nbytes
-            victims = self._select_victims_locked()
-        # Spill I/O happens OUTSIDE the lock: victims are already removed from
-        # the resident list (each ref is selected exactly once), so concurrent
-        # workers keep registering while gzip+write proceeds here.
-        if victims:
-            directory = os.path.join(self.root, self._stage)
-            freed = 0
-            for v in victims:
-                freed += v.spill(directory)
-            with self._lock:
-                self.spill_count += len(victims)
-                self.spilled_bytes += freed
+            victims, evicted_dev = self._select_victims_locked()
+        # Offload / spill I/O happens OUTSIDE the lock: victims are already
+        # removed from their resident list (each ref is selected exactly
+        # once), so concurrent workers keep registering while the device
+        # fetch / gzip+write proceeds here.
+        for v in dev_victims:
+            self._offload_ref(v)
+        self._spill_victims(victims, evicted_dev)
         return ref
+
+    def _select_dev_victims_locked(self):
+        """Oldest device refs past the HBM budget offload to host (the HBM
+        tier's spill step; host pressure then cascades to disk)."""
+        budget = self.hbm_budget()
+        if self._dev_bytes <= budget:
+            return []
+        victims = []
+        keep = []
+        for ref in self._dev_resident:
+            if self._dev_bytes > budget and ref.is_device:
+                victims.append(ref)
+                self._dev_bytes -= ref.dev_bytes
+            else:
+                keep.append(ref)
+        self._dev_resident = keep
+        return victims
+
+    def _spill_victims(self, victims, evicted_dev):
+        """Spill I/O for already-selected victims (outside the lock).
+        ``evicted_dev`` refs were HBM-resident with unevictable host
+        metadata: they offload and go straight to disk — both their device
+        bytes and host bytes were already deducted."""
+        if not victims and not evicted_dev:
+            return
+        directory = os.path.join(self.root, self._stage)
+        freed = 0
+        for v in evicted_dev:
+            v.offload()
+            freed += v.spill(directory)
+        for v in victims:
+            freed += v.spill(directory)
+        with self._lock:
+            self.spill_count += len(victims) + len(evicted_dev)
+            self.spilled_bytes += freed
+            self.hbm_offloads += len(evicted_dev)
+
+    def _offload_ref(self, ref):
+        """Device -> host for one ref (outside the lock), then re-balance
+        host residency, which may cascade to a disk spill."""
+        freed, host_delta = ref.offload()
+        if not freed and not host_delta:
+            return
+        with self._lock:
+            self.hbm_offloads += 1
+            self._resident_bytes += host_delta
+            victims, evicted_dev = self._select_victims_locked()
+        self._spill_victims(victims, evicted_dev)
 
     def _select_victims_locked(self):
         """Pick oldest unpinned refs until projected residency meets the
         budget; deduct their bytes immediately so other threads see the
-        budget as already relieved."""
+        budget as already relieved.  Returns (spill_victims, evicted_dev):
+        HBM-resident refs' host metadata (keys+hashes) is not spillable in
+        place, so under host pressure those refs are evicted whole —
+        offload + disk — and leave both accountings here."""
         if self._resident_bytes <= self.budget:
-            return []
+            return [], []
         victims = []
+        evicted_dev = []
         keep = []
         for ref in self._resident:
-            if (self._resident_bytes > self.budget and not ref.pin
-                    and ref.resident):
+            if self._resident_bytes <= self.budget or ref.pin:
+                keep.append(ref)
+            elif ref.resident:
                 victims.append(ref)
                 self._resident_bytes -= ref.nbytes
+            elif ref.is_device:
+                evicted_dev.append(ref)
+                self._resident_bytes -= ref.nbytes
+                if ref in self._dev_resident:
+                    self._dev_resident.remove(ref)
+                    self._dev_bytes -= ref.dev_bytes
             else:
                 keep.append(ref)
         self._resident = keep
@@ -296,13 +511,16 @@ class RunStore(object):
                 "({} > {} bytes); raise the budget or drop a cached()/"
                 "memory=True stage".format(
                     self._resident_bytes, self.budget))
-        return victims
+        return victims, evicted_dev
 
     def drop_ref(self, ref):
         with self._lock:
             if ref in self._resident:
                 self._resident.remove(ref)
                 self._resident_bytes -= ref.nbytes
+            if ref in self._dev_resident:
+                self._dev_resident.remove(ref)
+                self._dev_bytes -= ref.dev_bytes
         ref.delete()
 
     def release_ref(self, ref):

@@ -1,0 +1,203 @@
+"""HBM residency tier (SURVEY §2 item 6 / §7 sketch 1): numeric value
+lanes of reduce-feeding map outputs stay device-resident between map and
+reduce; device->host offload is the first spill step, disk the second.
+
+On the test rig "device" is the 8-way virtual CPU backend — the tier
+mechanics (budget, offload cascade, zero-copy consumption accounting) are
+backend-independent; what the counters claim is what the code did.
+"""
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.runner import MTRunner
+from dampr_tpu.storage import BlockRef, RunStore
+from dampr_tpu.blocks import Block
+
+
+@pytest.fixture(autouse=True)
+def hbm_enabled():
+    old = (settings.partitions, settings.mesh_fold, settings.hbm_budget,
+           settings.hbm_min_records)
+    settings.partitions = 8
+    settings.mesh_fold = "auto"
+    settings.hbm_budget = 64 * 1024 * 1024
+    settings.hbm_min_records = 1
+    yield
+    (settings.partitions, settings.mesh_fold, settings.hbm_budget,
+     settings.hbm_min_records) = old
+
+
+def _mkblock(n, key_mod=17, scale=1):
+    ks = np.arange(n, dtype=np.int64) % key_mod
+    vs = (np.arange(n, dtype=np.int64) % 100) * scale
+    return Block(ks, vs)
+
+
+class TestDeviceRefs:
+    def test_roundtrip_exact(self):
+        store = RunStore("hbm-rt")
+        blk = _mkblock(8192)
+        ref = store.register(blk, device=True)
+        assert ref.is_device
+        got = ref.get()
+        assert np.array_equal(got.keys, blk.keys)
+        assert np.array_equal(got.values, blk.values)
+        assert got.values.dtype == blk.values.dtype
+        assert store.d2h_bytes > 0  # the read was a counted fetch
+        store.cleanup()
+
+    def test_host_budget_charges_metadata_only(self):
+        store = RunStore("hbm-meta")
+        blk = _mkblock(8192)
+        ref = store.register(blk, device=True)
+        # Host side holds keys + two uint32 hash lanes; the value lane is
+        # device bytes.
+        h1, _ = blk.hashes()
+        assert ref.nbytes == blk.keys.nbytes + 2 * h1.nbytes
+        assert ref.dev_bytes > 0
+        store.cleanup()
+
+    def test_object_values_stay_host(self):
+        store = RunStore("hbm-obj")
+        vs = np.empty(100, dtype=object)
+        vs[:] = [("t", i) for i in range(100)]
+        blk = Block(np.arange(100, dtype=np.int64), vs)
+        ref = store.register(blk, device=True)
+        assert not ref.is_device
+        store.cleanup()
+
+    def test_offload_cascade_below_working_set(self):
+        # HBM budget below the working set: oldest device refs offload to
+        # host; host budget below that: cascade to disk.  Data stays exact.
+        old_hbm = settings.hbm_budget
+        settings.hbm_budget = 1 << 16  # 64 KB: far below working set
+        try:
+            store = RunStore("hbm-cascade", budget=1 << 17)
+            blocks = [_mkblock(8192, key_mod=50 + i) for i in range(8)]
+            refs = [store.register(b, device=True) for b in blocks]
+            assert store.hbm_offloads > 0, "nothing offloaded"
+            assert store.spill_count > 0, "host pressure never hit disk"
+            for b, r in zip(blocks, refs):
+                got = r.get()
+                assert np.array_equal(got.keys, b.keys)
+                assert np.array_equal(got.values, b.values)
+            store.cleanup()
+        finally:
+            settings.hbm_budget = old_hbm
+
+
+class TestBoundaryZeroCopy:
+    def test_fold_consumes_device_refs_without_host_copy(self):
+        # TF-IDF-shaped aggregation: map -> count fold.  The reduce must
+        # consume the map outputs' value lanes on device: d2h_bytes == 0
+        # (the only fetched data is the final distinct-key result, which
+        # _emit_keyed_fold materializes from the fold output, not from the
+        # map-output blocks).
+        pipe = (Dampr.memory(list(range(20000)), partitions=8)
+                .count(lambda x: x % 13))
+        pipe = pipe.checkpoint() if pipe.agg else pipe
+        runner = MTRunner("hbm-boundary", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        got = dict(v for _k, v in out[0].read())
+        want = {i: len(range(i, 20000, 13)) for i in range(13)}
+        assert got == want
+        assert runner.store.h2d_bytes > 0, "nothing rode the HBM tier"
+        assert runner.mesh_folds >= 1, "fold did not run on device"
+        assert runner.store.d2h_bytes == 0, (
+            "map->reduce boundary copied %d bytes through host"
+            % runner.store.d2h_bytes)
+
+    def test_sum_fold_exact_through_hbm(self):
+        data = list(range(30000))
+        pipe = (Dampr.memory(data, partitions=8)
+                .a_group_by(lambda x: x % 9).sum())
+        runner = MTRunner("hbm-sum", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        got = dict(v for _k, v in out[0].read())
+        want = {k: sum(range(k, 30000, 9)) for k in range(9)}
+        assert got == want
+        assert runner.store.h2d_bytes > 0
+
+    def test_host_fallback_still_exact_when_tier_disabled(self):
+        old = settings.hbm_budget
+        settings.hbm_budget = 0
+        try:
+            pipe = (Dampr.memory(list(range(20000)), partitions=8)
+                    .count(lambda x: x % 13))
+            pipe = pipe.checkpoint() if pipe.agg else pipe
+            runner = MTRunner("hbm-off", pipe.pmer.graph)
+            out = runner.run([pipe.source])
+            got = dict(v for _k, v in out[0].read())
+            assert got == {i: len(range(i, 20000, 13)) for i in range(13)}
+            assert runner.store.h2d_bytes == 0
+        finally:
+            settings.hbm_budget = old
+
+
+class TestLaneSafety:
+    def test_overflowing_values_stay_host(self):
+        # Values past the int32 lane (x64 off) must refuse the device tier
+        # and still fold exactly on host.
+        store = RunStore("hbm-lane")
+        big = Block(np.arange(8192, dtype=np.int64),
+                    np.full(8192, 2 ** 40, dtype=np.int64))
+        ref = store.register(big, device=True)
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            assert not ref.is_device
+        store.cleanup()
+
+    def test_huge_sum_pipeline_exact(self):
+        # End-to-end: values whose sum overflows int32 — the engine must
+        # deliver the exact total whichever tier/path it picks.
+        n = 9000
+        pipe = (Dampr.memory([2 ** 30 + i for i in range(n)], partitions=8)
+                .a_group_by(lambda x: 0).sum())
+        runner = MTRunner("hbm-huge", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        got = dict(v for _k, v in out[0].read())
+        assert got == {0: sum(2 ** 30 + i for i in range(n))}
+
+
+class TestIntersections:
+    def test_resume_persists_device_refs(self, tmp_path):
+        # resume=True must checkpoint HBM-resident stage outputs (their
+        # host block is None — persistence goes through get()).
+        old_scratch = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            def keyf(x):
+                return x % 7
+
+            pipe = (Dampr.memory(list(range(20000)), partitions=8)
+                    .count(keyf))
+            pipe = pipe.checkpoint() if pipe.agg else pipe
+            runner = MTRunner("hbm-resume", pipe.pmer.graph, resume=True)
+            out = runner.run([pipe.source])
+            got = dict(v for _k, v in out[0].read())
+            assert got == {i: len(range(i, 20000, 7)) for i in range(7)}
+            assert runner.store.h2d_bytes > 0
+        finally:
+            settings.scratch_root = old_scratch
+
+    def test_host_pressure_evicts_device_metadata(self):
+        # Device refs' host-side keys+hash metadata must be evictable under
+        # host pressure (offload + disk), never a spurious MemoryError.
+        old_hbm = settings.hbm_budget
+        settings.hbm_budget = 1 << 30  # roomy HBM, tiny host budget
+        try:
+            store = RunStore("hbm-hostpressure", budget=1 << 14)
+            blocks = [_mkblock(4096, key_mod=97 + i) for i in range(10)]
+            refs = [store.register(b, device=True) for b in blocks]
+            # host budget (16 KB) is far below 10 blocks' key+hash bytes
+            assert store.spill_count > 0
+            for b, r in zip(blocks, refs):
+                got = r.get()
+                assert np.array_equal(got.keys, b.keys)
+                assert np.array_equal(got.values, b.values)
+            store.cleanup()
+        finally:
+            settings.hbm_budget = old_hbm
