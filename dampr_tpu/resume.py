@@ -120,6 +120,14 @@ def _fp_function(f, depth):
               cells, defaults, kwdefaults, tuple(globs))
 
 
+def _is_composed(obj):
+    """A fused-chain link node (base.ComposedMapper/ComposedStreamable) —
+    type check by name avoids importing base at module load."""
+    from . import base
+
+    return type(obj) in (base.ComposedMapper, base.ComposedStreamable)
+
+
 def _fp(obj, depth=0):
     """Best-effort structural fingerprint.  Deterministic across processes
     for code + plain data; ``volatile:`` (never matches) when it cannot be."""
@@ -205,6 +213,22 @@ def _fp(obj, depth=0):
             (_fp(k, depth + 1), _fp(v, depth + 1)) for k, v in items))
     if isinstance(obj, type):
         return _h("type", obj.__module__, obj.__qualname__)
+    if _is_composed(obj):
+        # Fused op chains nest one Composed node per DSL op; walking them
+        # recursively would charge the depth budget per chain LINK, so a
+        # pipeline with >= _MAX_DEPTH chained per-record ops between
+        # checkpoints silently fingerprinted volatile (resume lost).
+        # Flatten iteratively: links fingerprint at THIS depth — the
+        # budget charges only genuinely nested user state.
+        links, stack = [], [obj]
+        while stack:
+            node = stack.pop()
+            if _is_composed(node):
+                stack.append(node.right)
+                stack.append(node.left)
+            else:
+                links.append(node)
+        return _h("opchain", tuple(_fp(x, depth) for x in links))
     # Generic object: type + attribute walk (slots and dict).  An object
     # exposing NO attributes (C-implemented callables and the like) hides
     # its state from the walk — hash its pickle if possible, else mark the
@@ -545,6 +569,7 @@ def gc_unreferenced(root):
         return
     live = _live_paths(root)
     n = 0
+    swept = []
     for d, _dirs, fs in os.walk(root):
         for f in fs:
             if not f.endswith(".blk"):
@@ -554,10 +579,19 @@ def gc_unreferenced(root):
                 try:
                     os.unlink(path)
                     n += 1
+                    if len(swept) < 20:
+                        swept.append(path)
                 except OSError:
                     pass
     if n:
-        log.info("resume gc: removed %d unreferenced block file(s)", n)
+        # WARNING level with the paths: the run-then-stream-lazily pattern
+        # (holding an unread OutputDataset from a previous volatile-tailed
+        # run of this name) loses exactly these files — make the loss
+        # visible and attributable, not silent.
+        log.warning(
+            "resume gc: removed %d unreferenced block file(s) under %s "
+            "(previous runs' unread volatile outputs are invalidated): %s%s",
+            n, root, ", ".join(swept), "…" if n > len(swept) else "")
 
 
 def load_plan(root, fps):

@@ -172,7 +172,7 @@ class OutputDataset(Dataset):
         (refs + concat + take) peak near 3x the output size, so it is gated
         at a third of the memory budget; uncomparable mixed keys also bail
         to the streamed merge."""
-        total = sum(r.nbytes for r in self.pset.all_refs())
+        total = sum(r.total_bytes for r in self.pset.all_refs())
         budget = (self.store.budget if self.store is not None
                   else settings.max_memory_per_stage)
         if total * 3 > budget:
@@ -532,7 +532,8 @@ class MTRunner(object):
                      or type(stage.mapper) in (base.MapCrossJoin,
                                                base.MapAllJoin))):
             refs = list(entries[0].all_refs())
-            if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
+            if sum(getattr(r, 'total_bytes', r.nbytes)
+                   for r in refs) <= settings.small_stage_bytes:
                 chunks = [BlockDataset(refs)]
 
         (job, combine_op, pin, feeds_reduce, _new_sink,
@@ -913,12 +914,16 @@ class MTRunner(object):
         class _HostPath(Exception):
             pass
 
-        # Distinct-key table: u64-sorted hash lanes with the matching keys.
-        # Grows with key cardinality only; replaces the former all-records
-        # host concat + sort + Python dict.
-        kt = {"u": np.empty(0, dtype=np.uint64),
-              "k": None}  # dtype set by the first window (stays numeric
-        #                   for numeric keys — the output block inherits it)
+        # Distinct-key table: u64-sorted hash lanes with the matching keys,
+        # kept as GEOMETRIC SEGMENTS (the logarithmic method): each window's
+        # new keys append as one sorted segment; equal-size neighbors merge
+        # pairwise, so every key participates in O(log W) linear merges —
+        # replacing a per-window np.insert whose O(table) rebuild degraded
+        # quadratically on high-cardinality folds.  Grows with key
+        # cardinality only; replaces the former all-records host concat +
+        # sort + Python dict.
+        kt = {"segs": [], "n": 0}  # [(u64 sorted, keys)], total entries
+
         partials = []  # folded (h1, h2, v) lane triples
 
         def keys_equal(a, b):
@@ -926,9 +931,27 @@ class MTRunner(object):
                 return bool(np.all(a == b))
             return all(x == y for x, y in zip(a, b))
 
+        def merge_segs(a, b):
+            """Allocate-once merge of two disjoint sorted (u, k) segments."""
+            ua, ka = a
+            ub, kb = b
+            n = len(ua) + len(ub)
+            tgt = np.searchsorted(ua, ub) + np.arange(len(ub))
+            ou = np.empty(n, dtype=np.uint64)
+            mask = np.ones(n, dtype=bool)
+            mask[tgt] = False
+            ou[tgt] = ub
+            ou[mask] = ua
+            if ka.dtype != kb.dtype:
+                allk = _concat_cols([ka, kb])
+                ka, kb = allk[:len(ka)], allk[len(ka):]
+            ok = np.empty(n, dtype=ka.dtype)
+            ok[tgt] = kb
+            ok[mask] = ka
+            return ou, ok
+
         def merge_table(keys, h1, h2):
-            """Fold the window's (hash -> key) pairs into the sorted table —
-            sort only the window, then a linear searchsorted+insert merge —
+            """Fold the window's (hash -> key) pairs into the segment table,
             verifying equal 64-bit hashes always carry equal keys."""
             u = combine64(h1, h2)
             worder = np.argsort(u, kind="stable")
@@ -944,28 +967,40 @@ class MTRunner(object):
             keep = np.flatnonzero(first)
             su = su[keep]
             sk = sk.take(keep)
-            if kt["k"] is None:
-                kt["u"], kt["k"] = su, sk
-            else:
-                if kt["k"].dtype != sk.dtype:
-                    nk = len(kt["k"])
-                    both = _concat_cols([kt["k"], sk])
-                    kt["k"] = both[:nk]
-                    sk = both[nk:]
-                pos = np.searchsorted(kt["u"], su)
-                pos_c = np.minimum(pos, max(len(kt["u"]) - 1, 0))
-                exists = (kt["u"][pos_c] == su) if len(kt["u"]) else (
-                    np.zeros(len(su), dtype=bool))
-                hit = np.flatnonzero(exists)
+            # Cross-segment exists check (every segment is consulted; a key
+            # lives in exactly one).
+            new_mask = np.ones(len(su), dtype=bool)
+            for eu, ek in kt["segs"]:
+                pos_c = np.minimum(np.searchsorted(eu, su), len(eu) - 1)
+                exists = eu[pos_c] == su
+                hit = np.flatnonzero(exists & new_mask)
                 if len(hit) and not keys_equal(
-                        sk.take(hit), kt["k"].take(pos_c[hit])):
+                        sk.take(hit), ek.take(pos_c[hit])):
                     raise _HostPath  # cross-window 64-bit collision
-                new = np.flatnonzero(~exists)
-                if len(new):
-                    kt["u"] = np.insert(kt["u"], pos[new], su[new])
-                    kt["k"] = np.insert(kt["k"], pos[new], sk.take(new))
-            if len(kt["u"]) * 80 > acc_budget:
+                new_mask &= ~exists
+            idx = np.flatnonzero(new_mask)
+            if len(idx):
+                kt["segs"].append((su[idx], sk.take(idx)))
+                kt["n"] += len(idx)
+                while (len(kt["segs"]) > 1
+                       and len(kt["segs"][-2][0])
+                       <= 2 * len(kt["segs"][-1][0])):
+                    b = kt["segs"].pop()
+                    a = kt["segs"].pop()
+                    kt["segs"].append(merge_segs(a, b))
+            if kt["n"] * 80 > acc_budget:
                 raise _HostPath  # extreme cardinality: stream on host
+
+        def table_compact():
+            """Merge all segments into the single sorted (u, k) table the
+            final hash -> key join consumes."""
+            while len(kt["segs"]) > 1:
+                b = kt["segs"].pop()
+                a = kt["segs"].pop()
+                kt["segs"].append(merge_segs(a, b))
+            if kt["segs"]:
+                return kt["segs"][0]
+            return np.empty(0, dtype=np.uint64), np.empty(0, dtype=object)
 
         # Device-resident accumulation state: partials are the raw padded
         # (h1, h2, v, ok) jax arrays from each window's collective fold —
@@ -1129,12 +1164,13 @@ class MTRunner(object):
         fh1 = np.asarray(rh1)[mask]
         fh2 = np.asarray(rh2)[mask]
         fv = np.asarray(rv)[mask]
-        # Vectorized hash -> key join against the sorted table (every output
-        # hash entered the table with its window).
+        # Vectorized hash -> key join against the compacted table (every
+        # output hash entered the table with its window).
+        tu, tk = table_compact()
         fu = combine64(fh1, fh2)
-        idx = np.minimum(np.searchsorted(kt["u"], fu), len(kt["u"]) - 1)
-        assert bool(np.all(kt["u"][idx] == fu)), "mesh fold lost a key"
-        out_keys = kt["k"].take(idx)
+        idx = np.minimum(np.searchsorted(tu, fu), len(tu) - 1)
+        assert bool(np.all(tu[idx] == fu)), "mesh fold lost a key"
+        out_keys = tk.take(idx)
 
         pin = bool(stage.options.get("memory"))
         pset, nrec = self._emit_keyed_fold(out_keys, fv, fh1, fh2, pin)
@@ -1237,7 +1273,8 @@ class MTRunner(object):
         thr = settings.streaming_reduce_threshold
         if thr is None:
             thr = self.store.budget
-        if sum(r.nbytes for r in refs) > min(limit, thr):
+        if sum(getattr(r, 'total_bytes', r.nbytes)
+               for r in refs) > min(limit, thr):
             return None
         merged = Block.concat([r.get() for r in refs])
         if not len(merged):
@@ -1354,7 +1391,7 @@ class MTRunner(object):
 
         def job(pid):
             if joinable and len(entries) == 2:
-                sizes = [sum(r.nbytes for r in pset.refs(pid))
+                sizes = [sum(r.total_bytes for r in pset.refs(pid))
                          for pset in entries]
                 if sum(sizes) > threshold:
                     # Over-budget join partition: hash-ordered streaming
@@ -1382,7 +1419,7 @@ class MTRunner(object):
             record_stream = None
             if len(entries) == 1:
                 prefs = entries[0].refs(pid)
-                part_bytes = sum(r.nbytes for r in prefs)
+                part_bytes = sum(r.total_bytes for r in prefs)
                 if (part_bytes > threshold
                         and isinstance(stage.reducer, base.AssocFoldReducer)
                         and stage.reducer.op.kind is not None):
@@ -1393,7 +1430,7 @@ class MTRunner(object):
                 views = []
                 for pset in entries:
                     refs = pset.refs(pid)
-                    part_bytes = sum(r.nbytes for r in refs)
+                    part_bytes = sum(r.total_bytes for r in refs)
                     if (len(entries) == 1 and order_insensitive
                             and part_bytes > threshold):
                         # Out-of-core partition: stream a k-way merge over
@@ -1456,7 +1493,8 @@ class MTRunner(object):
                 and isinstance(entries[0], storage.PartitionSet)
                 and type(stage.sinker) in (base.Map, base.ComposedMapper)):
             refs = list(entries[0].all_refs())
-            if sum(r.nbytes for r in refs) <= settings.small_stage_bytes:
+            if sum(getattr(r, 'total_bytes', r.nbytes)
+                   for r in refs) <= settings.small_stage_bytes:
                 chunks = [BlockDataset(refs)]
         os.makedirs(stage.path, exist_ok=True)
 
@@ -1495,10 +1533,13 @@ class MTRunner(object):
         # when the exclusive probe proves no other live run is mid-flight
         # under this name; we then downgrade to shared for our duration.
         guard = _resume.RunGuard(self.store.root)
-        if guard.exclusive:
-            _resume.gc_unreferenced(self.store.root)
-        guard.share()
         try:
+            # Inside the try so a failure in the sweep or the shared
+            # downgrade can never leak the flock fd (which would block
+            # other runs' GC under this name until process exit).
+            if guard.exclusive:
+                _resume.gc_unreferenced(self.store.root)
+            guard.share()
             return self._run_stages(outputs, cleanup)
         finally:
             guard.close()

@@ -127,8 +127,12 @@ class BlockRef(object):
         self.dev_bytes = lane_vals.nbytes + h1.nbytes + h2.nbytes
         self._kmeta = (block.keys, h1, h2)
         self._block = None
-        # Host budget is charged for what stays host-resident.
-        self.nbytes = block.keys.nbytes + h1.nbytes + h2.nbytes
+        # Host budget is charged for what stays host-resident; object key
+        # lanes charge the same 64 B/record heuristic Block.nbytes uses
+        # (raw .nbytes would count 8-byte pointers, not the strings).
+        kb = (block.keys.nbytes if block.keys.dtype != object
+              else len(block.keys) * 64)
+        self.nbytes = kb + h1.nbytes + h2.nbytes
 
     @property
     def is_device(self):
@@ -183,6 +187,12 @@ class BlockRef(object):
 
     def __len__(self):
         return self.nrecords
+
+    @property
+    def total_bytes(self):
+        """Host + device bytes: what size-based gates must sum (nbytes
+        alone hides an HBM-resident value lane)."""
+        return self.nbytes + self.dev_bytes
 
     @property
     def resident(self):
@@ -429,7 +439,10 @@ class RunStore(object):
 
     def _select_dev_victims_locked(self):
         """Oldest device refs past the HBM budget offload to host (the HBM
-        tier's spill step; host pressure then cascades to disk)."""
+        tier's spill step; host pressure then cascades to disk).  Selected
+        refs leave BOTH resident lists here, so no later selection — host
+        victims in the same register call included — can pick them twice;
+        _offload_ref re-enters them as plain host refs."""
         budget = self.hbm_budget()
         if self._dev_bytes <= budget:
             return []
@@ -439,6 +452,9 @@ class RunStore(object):
             if self._dev_bytes > budget and ref.is_device:
                 victims.append(ref)
                 self._dev_bytes -= ref.dev_bytes
+                if ref in self._resident:
+                    self._resident.remove(ref)
+                    self._resident_bytes -= ref.nbytes
             else:
                 keep.append(ref)
         self._dev_resident = keep
@@ -464,14 +480,16 @@ class RunStore(object):
             self.hbm_offloads += len(evicted_dev)
 
     def _offload_ref(self, ref):
-        """Device -> host for one ref (outside the lock), then re-balance
-        host residency, which may cascade to a disk spill."""
-        freed, host_delta = ref.offload()
-        if not freed and not host_delta:
-            return
+        """Device -> host for one ref already removed from both resident
+        lists (outside the lock), then re-enter it as a plain host ref,
+        which may cascade to a disk spill."""
+        freed, _delta = ref.offload()
+        if not freed:
+            return  # raced with a concurrent drop
         with self._lock:
             self.hbm_offloads += 1
-            self._resident_bytes += host_delta
+            self._resident.append(ref)
+            self._resident_bytes += ref.nbytes
             victims, evicted_dev = self._select_victims_locked()
         self._spill_victims(victims, evicted_dev)
 

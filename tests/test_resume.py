@@ -439,3 +439,22 @@ class TestFingerprintSharpness:
         # Volatile stages can never be resumed; reruns must not accumulate
         # their spill files.
         assert n2 <= n1
+
+
+class TestChainDepth:
+    def test_long_op_chains_stay_resumable(self):
+        # >= 6 chained per-record ops must NOT fingerprint volatile: fused
+        # Composed chains flatten before the depth budget applies.
+        from dampr_tpu import resume
+        from dampr_tpu.base import Filter, ValueMap, fuse
+
+        ops = [ValueMap(lambda x, i=i: x + i) for i in range(10)]
+        ops.insert(5, Filter(lambda x: x % 2 == 0))
+        fused = fuse(ops)
+        fp1 = resume._fp(fused)
+        assert not resume.is_volatile(fp1), "11-op chain went volatile"
+        # determinism + sensitivity: same chain again matches, an edited
+        # link does not
+        assert resume._fp(fuse([ValueMap(lambda x, i=i: x + i)
+                                for i in range(10)]
+                               + [Filter(lambda x: x % 2 == 0)])) != fp1
