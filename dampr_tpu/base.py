@@ -547,15 +547,14 @@ class StreamingGroupedView(object):
         self.refs = refs
 
     def _run_stream(self, ref, run_idx):
+        from .blocks import pylist
+
         for window in ref.iter_windows():
-            keys, vals = window.keys, window.values
+            keys = pylist(window.keys)
+            vals = pylist(window.values)
             h1, h2 = window.hashes()
             for i in range(len(keys)):
-                k = keys[i]
-                v = vals[i]
-                yield (int(h1[i]), int(h2[i]), run_idx,
-                       k.item() if isinstance(k, np.generic) else k,
-                       v.item() if isinstance(v, np.generic) else v)
+                yield (int(h1[i]), int(h2[i]), run_idx, keys[i], vals[i])
 
     def grouped_read(self):
         """Yield (key, value_iter) per group, groupby-style: advancing to the
@@ -747,15 +746,26 @@ class GroupedView(object):
         return len(self._starts)
 
     def grouped_read(self):
+        from .blocks import pylist
+
         sb = self._groups.block
-        keys, vals = sb.keys, sb.values
+        keys = sb.keys
+        vals = sb.values
+
+        def group_values(s, e, _W=8192):
+            # windowed C-level conversion: a near-budget partition never
+            # boxes its whole lane at once, a hot key never boxes its
+            # whole group
+            for w0 in range(s, e, _W):
+                for v in pylist(vals[w0:min(e, w0 + _W)]):
+                    yield v
+
         for gi in self._order:
             s, e = self._starts[gi], self._ends[gi]
             k = keys[s]
             yield (
                 k.item() if isinstance(k, np.generic) else k,
-                (v.item() if isinstance(v, np.generic) else v
-                 for v in vals[s:e]),
+                group_values(s, e),
             )
 
     def read(self):
@@ -873,17 +883,16 @@ class AssocFoldReducer(Reducer):
         assert len(datasets) == 1
         view = datasets[0]
         if isinstance(view, GroupedView):
+            from .blocks import pylist
+
             groups = view.sorted_groups()
             folded = segment.fold_sorted(groups, self.op)
             order = view.key_order()
-            keys = folded.keys
-            vals = folded.values
+            keys = pylist(folded.keys)
+            vals = pylist(folded.values)
             for gi in order:
                 k = keys[gi]
-                v = vals[gi]
-                k = k.item() if isinstance(k, np.generic) else k
-                v = v.item() if isinstance(v, np.generic) else v
-                yield k, (k, v)
+                yield k, (k, vals[gi])
         else:
             fn = self.op.fn
             for k, vs in view.grouped_read():

@@ -32,10 +32,46 @@ _I64_MIN = -(2 ** 63)
 _I64_MAX = 2 ** 63 - 1
 
 
-def _column_from_list(xs):
-    """Build the tightest column for a list of Python values."""
+def _tuple_column(xs):
+    """Type-uniform numeric tuples -> a 2D composite lane, so pair-shaped
+    accumulators — mean's (sum, count) being the canonical one — ride the
+    same segment kernels and reduceat folds as scalar lanes.  STRICT type
+    fidelity: every element of every tuple must be the same plain type
+    (all int -> int64 matrix, all float -> float64 matrix).  Anything
+    mixed, bool, or out-of-int64 stays on the object lane — a promotion
+    would change what the user reads back ((0, 6.0) must not become
+    (0.0, 6.0)).  Returns None when the tuples don't qualify."""
+    w = len(xs[0])
+    if not 2 <= w <= 8 or set(map(len, xs)) != {w}:
+        return None
+    ts = set()
+    for x in xs:
+        ts.update(map(type, x))
+        if len(ts) > 1:
+            return None
+    if ts == {int}:
+        try:
+            return np.array(xs, dtype=np.int64)
+        except OverflowError:
+            return None
+    if ts == {float}:
+        return np.array(xs, dtype=np.float64)
+    return None
+
+
+def _column_from_list(xs, composite=False):
+    """Build the tightest column for a list of Python values.
+    ``composite=True`` (VALUE columns only) lets type-uniform numeric
+    tuples build a 2D lane; key columns must stay 1D — the hash/sort/
+    group machinery is lane-shaped, so tuple keys ride the object lane
+    and hash via their canonical encoding."""
     n = len(xs)
     ts = set(map(type, xs))
+    if composite and ts == {tuple}:
+        col2d = _tuple_column(xs)
+        if col2d is not None:
+            return col2d
+        # fall through to the object lane below
     if ts == {bool}:
         # Preserve bool values exactly (True round-trips as True, not 1); the
         # reference's pickled streams preserve bools and so do we.  Mixed
@@ -69,8 +105,11 @@ def is_numeric(col):
 def pylist(col):
     """Column -> plain-Python list.  One C-level tolist per lane; object
     lanes get one extra pass unboxing stray numpy scalars, so consumers
-    (user binops, result readers) always see pure Python values."""
+    (user binops, result readers) always see pure Python values.  2D
+    composite lanes restore the tuples they were built from."""
     lst = col.tolist()
+    if col.ndim == 2:
+        return [tuple(r) for r in lst]
     if col.dtype == object:
         lst = [x.item() if isinstance(x, np.generic) else x for x in lst]
     return lst
@@ -96,14 +135,16 @@ class Block(object):
         for i, (k, v) in enumerate(pairs):
             ks[i] = k
             vs[i] = v
-        return cls(_column_from_list(ks), _column_from_list(vs))
+        return cls(_column_from_list(ks),
+                   _column_from_list(vs, composite=True))
 
     @classmethod
     def from_lists(cls, ks, vs):
         """Build a block from parallel key/value lists (the batched-UDF
         path's native shape — no per-record tuple boxing)."""
         assert len(ks) == len(vs)
-        return cls(_column_from_list(ks), _column_from_list(vs))
+        return cls(_column_from_list(ks),
+                   _column_from_list(vs, composite=True))
 
     @classmethod
     def empty(cls):
@@ -180,7 +221,9 @@ class Block(object):
     def take(self, idx):
         return Block(
             self.keys.take(idx),
-            self.values.take(idx),
+            # fancy indexing, not take: composite value lanes are 2D and
+            # must gather whole rows
+            self.values[idx],
             None if self.h1 is None else self.h1.take(idx),
             None if self.h2 is None else self.h2.take(idx),
         )
@@ -217,6 +260,19 @@ class Block(object):
 
 
 def _concat_cols(cols):
+    widths = {c.shape[1] if c.ndim == 2 else 0 for c in cols}
+    if len(widths) > 1:
+        # Mixed composite widths / composite-with-scalar: rows box back to
+        # tuples on the object lane (pylist round-trip semantics).
+        return _as_object_concat(cols)
+    if widths != {0}:
+        dtypes = {c.dtype for c in cols}
+        if len(dtypes) == 1:
+            return np.concatenate(cols)
+        for c in cols:
+            if c.dtype == np.int64 and len(c) and np.abs(c).max() > 2 ** 53:
+                return _as_object_concat(cols)
+        return np.concatenate([c.astype(np.float64) for c in cols])
     dtypes = {c.dtype for c in cols}
     if len(dtypes) == 1 and object not in dtypes:
         return np.concatenate(cols)
@@ -243,6 +299,8 @@ def _as_object_concat(cols):
     for c in cols:
         if c.dtype == object:
             out[at: at + len(c)] = c
+        elif c.ndim == 2:
+            out[at: at + len(c)] = [tuple(r) for r in c.tolist()]
         else:
             # .item()-ize so downstream sees Python scalars, matching
             # iter_pairs semantics for values that started in object lanes.

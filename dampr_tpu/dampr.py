@@ -30,7 +30,7 @@ from .base import (AssocFoldReducer, Filter, FlatMap, Inspect, KeyedInnerJoin,
                    MapCrossJoin, MapKeys, MapValues, Mapper,
                    PartialReduceCombiner, Prefix, Reducer, Rekey, Sample,
                    StreamMapper, StreamReducer, Streamable, Suffix, ValueMap,
-                   _identity, fuse)
+                   _identity, _shared_instance_deepcopy, fuse)
 from .dataset import CatDataset, Chunker
 from .graph import Graph, Source
 from .inputs import MemoryInput, PathInput, UrlsInput
@@ -108,6 +108,49 @@ class PBase(object):
     def read(self, k=None, **kwargs):
         """Shorthand for run() + read()."""
         return self.run(**kwargs).read(k)
+
+
+class _TopKBlocks(Mapper):
+    """Per-chunk top-k candidate selection at block granularity: numeric
+    1D value lanes select with one np.argpartition per block, then the
+    tiny per-block winners merge through nlargest.  Non-block chunks and
+    object/composite lanes stream through the same decorated-pair
+    nlargest as the DSL's generic path — emitted candidate records are
+    identical either way: ``(1, (x, x))``."""
+
+    __deepcopy__ = _shared_instance_deepcopy
+
+    def __init__(self, k):
+        self.k = k
+
+    def map(self, *datasets):
+        import heapq
+
+        import numpy as np
+
+        from .blocks import pylist
+
+        assert len(datasets) == 1
+        ds = datasets[0]
+        k = self.k
+        if k <= 0:
+            return
+        if hasattr(ds, "iter_blocks"):
+            blocks = [b for b in ds.iter_blocks() if len(b)]
+            if all(b.values.dtype != object and b.values.ndim == 1
+                   for b in blocks):
+                cands = []
+                for b in blocks:
+                    v = b.values
+                    if len(v) > k:
+                        v = v[np.argpartition(v, len(v) - k)[len(v) - k:]]
+                    cands.extend((x, x) for x in pylist(v))
+                for p in heapq.nlargest(k, cands):
+                    yield 1, p
+                return
+        it = (v for _k, v in ds.read())
+        for p in heapq.nlargest(k, ((x, x) for x in it)):
+            yield 1, p
 
 
 class PMap(PBase):
@@ -225,70 +268,75 @@ class PMap(PBase):
         return self.a_group_by(key, lambda v: 1).reduce(segment.SUM, **options)
 
     def mean(self, key=lambda x: 1, value=lambda x: x, **options):
-        """Per-key mean via (sum, count) pair folding."""
-        def _mean_binop(x, y):
-            return x[0] + y[0], x[1] + y[1]
+        """Per-key mean: the (sum, count) pair IS the value column — int
+        and float values build a 2D composite lane the segment sum kernels
+        fold in one vectorized pass (blocks._tuple_column); anything else
+        falls back to an exact pairwise object-lane fold.  Same observable
+        behavior as the reference's per-record tuple binop (ref
+        dampr.py:445-458), different execution: the pair never exists as
+        a per-record Python object on the numeric path."""
+        def _pair(v):
+            x = value(v)
+            # count carries the value's own lane dtype so the pair stays
+            # type-uniform (a mixed (float, int) tuple would force the
+            # object lane); ints keep exact int64 sums.
+            return (x, 1.0) if type(x) is float else (x, 1)
 
-        def _average(x):
+        def _avg(x):
             return (x[0], x[1][0] / float(x[1][1]))
 
-        return (self.a_group_by(key, lambda v: (value(v), 1))
-                .reduce(_mean_binop, **options)
-                .map(_average))
+        return (self.a_group_by(key, _pair)
+                .reduce(segment.PAIR_SUM, **options)
+                .map(_avg))
 
     def len(self):
-        """Count all items in the collection.  With no pending per-record ops
-        the map side uses a vectorized record counter (newline counting on
-        raw text chunks); semantics are identical either way."""
-        def _map_count(items):
-            count = 0
-            for _ in items:
-                count += 1
-            yield 1, count
+        """Count all items in the collection.  With no pending per-record
+        ops the map side never touches records: text chunks count owned
+        newlines, block-backed chunks sum block lengths (CountRecords).
+        Pending ops force one streamed pass — the count is of TRANSFORMED
+        records (a flat_map changes it), so there is nothing to vectorize."""
+        def _count_stream(values):
+            return ((1, sum(1 for _ in values)),)
 
-        def _reduce_count(groups):
-            count = 0
-            not_empty = False
-            for _, counts in groups:
-                not_empty = True
-                for c in counts:
-                    count += c
-            if not_empty:
-                yield 1, count
+        def _sum_counts(groups):
+            totals = [c for _k, cs in groups for c in cs]
+            return ((1, sum(totals)),) if totals else ()
 
         if not self.agg:
             from .ops.text import CountRecords
             head = self.custom_mapper(CountRecords())
         else:
-            head = self.partition_map(_map_count)
+            head = self.partition_map(_count_stream)
         return (head
-                .partition_reduce(_reduce_count)
+                .partition_reduce(_sum_counts)
                 .map(lambda x: x[1]))
 
     def topk(self, k, value=None):
-        """Top-k values by a comparable key (per-partition heaps then a
-        global heap merge)."""
+        """Top-k values by a comparable sort key.  Identity-keyed
+        block-backed partitions select candidates with one np.argpartition
+        per block — no per-record Python; everything else decorates once
+        and takes ``heapq.nlargest`` per partition.  Candidates from all
+        partitions merge through one global nlargest.  Ordering criterion
+        is the (sort_key, value) pair, so tie behavior matches the
+        reference's heap of pairs (ref dampr.py:621-652)."""
         import heapq
 
-        if value is None:
-            value = lambda x: x  # noqa: E731
+        vf = value
 
-        def map_topk(it):
-            heap = []
-            for x in it:
-                heapq.heappush(heap, (value(x), x))
-                if len(heap) > k:
-                    heapq.heappop(heap)
-            return ((1, x) for x in heap)
+        def _cands(values):
+            pairs = (((x, x) for x in values) if vf is None
+                     else ((vf(x), x) for x in values))
+            return ((1, p) for p in heapq.nlargest(k, pairs))
 
-        def reduce_topk(it):
-            counts = (v for _k, vit in it for v in vit)
-            for _count, x in heapq.nlargest(k, counts):
-                yield x, 1
+        def _select(groups):
+            cands = (p for _one, ps in groups for p in ps)
+            return ((p[1], 1) for p in heapq.nlargest(k, cands))
 
-        return (self.partition_map(map_topk)
-                .partition_reduce(reduce_topk)
-                .map(lambda x: x[0]))
+        if vf is None and not self.agg:
+            head = self.custom_mapper(_TopKBlocks(k))
+        else:
+            head = self.partition_map(_cands)
+        return head.partition_reduce(_select).map(lambda x: x[0])
 
     # -- custom operators --------------------------------------------------
     def custom_mapper(self, mapper, name=None, **options):

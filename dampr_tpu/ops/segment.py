@@ -30,13 +30,17 @@ from .. import settings
 class AssocOp(object):
     """Descriptor for an associative binop.  ``kind`` is a device-foldable tag
     ('sum'|'min'|'max') or None for opaque Python binops (host dict combine).
-    ``fn`` is the Python binop used for host fallback and object values."""
+    ``fn`` is the Python binop used for host fallback and object values.
+    ``elementwise`` marks ops whose fn IS elementwise over tuple/composite
+    values, so 2D lanes may fold vectorized — a plain ``min`` over tuples
+    is lexicographic, NOT elementwise, and must stay on the fn path."""
 
-    __slots__ = ("kind", "fn")
+    __slots__ = ("kind", "fn", "elementwise")
 
-    def __init__(self, kind, fn):
+    def __init__(self, kind, fn, elementwise=False):
         self.kind = kind
         self.fn = fn
+        self.elementwise = elementwise
 
     def __call__(self, a, b):
         return self.fn(a, b)
@@ -46,6 +50,12 @@ SUM = AssocOp("sum", lambda a, b: a + b)
 MIN = AssocOp("min", lambda a, b: a if a <= b else b)
 MAX = AssocOp("max", lambda a, b: a if a >= b else b)
 FIRST = AssocOp("first", lambda a, _b: a)
+#: Elementwise pair sum: composite (sum, count)-style accumulators.  The
+#: "sum" kind rides the vectorized 2D-lane segment kernels; the fn gives
+#: object-lane tuples an exact pairwise fold (plain SUM.fn would
+#: CONCATENATE tuples).
+PAIR_SUM = AssocOp("sum", lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                   elementwise=True)
 
 
 def _builtin_ops():
@@ -171,15 +181,16 @@ class SortedGroups(object):
     def iter_groups(self):
         """Yield (key, [values]) per group — values materialized as a list,
         mirroring the reference's grouped_read (dataset.py:429-433)."""
+        from ..blocks import pylist
+
         starts, ends = self.bounds()
         keys = self.block.keys
         vals = self.block.values
         for i in range(len(starts)):
             k = keys[starts[i]]
-            vs = vals[starts[i]: ends[i]]
             yield (
                 k.item() if isinstance(k, np.generic) else k,
-                [v.item() if isinstance(v, np.generic) else v for v in vs],
+                pylist(vals[starts[i]: ends[i]]),
             )
 
 
@@ -249,7 +260,7 @@ def _repair_collisions(sb, starts_mask):
                 new_mask[s + sl] = True
     # apply permutation in place
     sb.keys = sb.keys.take(perm)
-    sb.values = sb.values.take(perm)
+    sb.values = sb.values[perm]
     sb.h1 = sb.h1.take(perm)
     sb.h2 = sb.h2.take(perm)
     return new_mask
@@ -341,9 +352,14 @@ def fold_sorted(groups, op):
     if op.kind == "first":
         # Stable sort preserves arrival order within groups, so the group's
         # first record is at its start offset — a pure gather, any dtype.
-        return Block(keys, sb.values.take(starts), kh1, kh2)
+        return Block(keys, sb.values[starts], kh1, kh2)
 
-    if op.kind in _NP_FOLD and sb.numeric_values:
+    if (op.kind in _NP_FOLD and sb.numeric_values
+            and (sb.values.ndim == 1 or op.elementwise)):
+        # 2D composite lanes only fold vectorized for ops declaring
+        # elementwise tuple semantics (PAIR_SUM); a generic min/max/add
+        # over tuples means lexicographic-compare / concatenation and
+        # takes the fn path below.
         vals = sb.values
         if vals.dtype == np.bool_:
             # Python semantics: True + True == 2; promote before folding
@@ -386,7 +402,8 @@ def fold_sorted(groups, op):
             if npad != n:
                 pad_val = {"sum": 0, "min": vals.dtype.type(np.inf) if vals.dtype.kind == "f" else np.iinfo(vals.dtype).max,
                            "max": vals.dtype.type(-np.inf) if vals.dtype.kind == "f" else np.iinfo(vals.dtype).min}[op.kind]
-                vals = np.pad(vals, (0, npad - n), constant_values=pad_val)
+                pad_spec = ((0, npad - n), (0, 0)) if vals.ndim == 2 else (0, npad - n)
+                vals = np.pad(vals, pad_spec, constant_values=pad_val)
                 seg_ids = np.pad(seg_ids, (0, npad - n), constant_values=ng_pad - 1)
             folded = np.asarray(
                 _segment_fold_jit(op.kind, ng_pad)(vals, seg_ids.astype(np.int32)))[:ng]

@@ -1023,6 +1023,8 @@ class MTRunner(object):
             if not len(blk):
                 return
             vals = blk.values
+            if vals.ndim != 1:
+                raise _HostPath  # composite lanes fold on the segment path
             if vals.dtype == np.bool_:
                 vals = vals.astype(np.int64)
             if vals.dtype == np.float64 and not x64:
@@ -1292,15 +1294,15 @@ class MTRunner(object):
         shape), np.generic values unwrapped to Python scalars, split by the
         engine hash % P.  Shared by the mesh fold and tiny-fold fast paths
         so the contract lives in exactly one place."""
+        from .blocks import pylist
+
         P = self.n_partitions
         n = len(keys)
+        kl = pylist(keys) if isinstance(keys, np.ndarray) else list(keys)
+        vl = pylist(vals) if isinstance(vals, np.ndarray) else list(vals)
         vcol = np.empty(n, dtype=object)
         for i in range(n):
-            k = keys[i]
-            if isinstance(k, np.generic):
-                k = k.item()
-            v = vals[i]
-            vcol[i] = (k, v.item() if isinstance(v, np.generic) else v)
+            vcol[i] = (kl[i], vl[i])
         out_blk = Block(keys, vcol, h1, h2)
         pset = storage.PartitionSet(P)
         nrec = 0

@@ -88,6 +88,8 @@ class BlockRef(object):
 
         x64 = jax.config.jax_enable_x64
         dt = values.dtype
+        if values.ndim != 1:
+            return None  # composite lanes: mesh fold lanes are 1D-shaped
         if dt == object or dt == np.uint64 or (
                 dt == np.float64 and not x64):
             return None

@@ -1,19 +1,43 @@
-"""SQLite inverted index over line files (reference dampr/utils/indexer.py).
+"""Inverted index built ON the engine's columnar substrate.
 
-``build`` runs a Dampr pipeline that writes a hidden ``.<name>.index`` SQLite
-DB per input file mapping keys to byte offsets; ``union``/``intersect`` stream
-back the matching lines by seeking.  Offsets here are byte offsets (binary
-seek), making lookups exact regardless of encoding.
+Capability parity with the reference's indexer utility (reference
+dampr/utils/indexer.py: per-file hidden SQLite DB, ``build``/``union``/
+``intersect`` surface), engine-native construction and querying:
+
+- **build**: each file's (token, byte-offset) postings accumulate as
+  columnar Blocks and group through the vectorized hash-sort kernels
+  (ops/segment.sort_and_group) — no per-posting SQL rows, no B-tree
+  insert churn.  Each token stores ONE row: its offsets as a packed
+  int64 array (ascending — stable sort preserves scan order).
+- **union / intersect**: the matching tokens' offset arrays combine with
+  vectorized set ops (np.unique over the concatenation); ``intersect``
+  counts matched postings per offset, reproducing the reference's
+  occurrence-counting semantics (a key appearing twice on a line counts
+  twice toward ``min_match``).
+- Lookups stream the matching lines back through a Dampr pipeline, one
+  seek per offset, exactly like the reference.
+
+The on-disk container stays a hidden per-file SQLite DB (one row per
+token), so index files remain single ordinary files; all queries are
+parameterized (hostile keys select nothing — they can never execute).
 """
 
 import logging
 import os
 import sqlite3
 
+import numpy as np
+
+from ..blocks import Block
 from ..dampr import Dampr
 from ..inputs import read_paths
+from ..ops import segment
 
 log = logging.getLogger("dampr_tpu.indexer")
+
+#: Postings batch: (token, offset) pairs accumulate into blocks of this
+#: many records before grouping.
+_BATCH = 1 << 16
 
 
 class Indexer(object):
@@ -28,76 +52,99 @@ class Indexer(object):
     def exists(self, path):
         return os.path.isfile(self.get_idx(path))
 
-    def _open_db(self, path, delete=False):
-        idx = self.get_idx(path)
-        if delete and os.path.isfile(idx):
-            os.unlink(idx)
-        return sqlite3.connect(idx)
+    # -- build -------------------------------------------------------------
+    def _index_one(self, fname, key_f):
+        """Group one file's postings through the segment kernels and store
+        one packed row per token.  Returns the posting count."""
+        ks, vs, blocks = [], [], []
+        off = 0
+        with open(fname, "rb") as f:
+            for raw in f:
+                # key_f sees the line WITH its terminator — reference
+                # parity (its indexer never stripped the newline).
+                for tok in key_f(raw.decode("utf-8")):
+                    ks.append(tok)
+                    vs.append(off)
+                off += len(raw)
+                if len(ks) >= _BATCH:
+                    blocks.append(Block.from_lists(ks, vs))
+                    ks, vs = [], []
+        if ks:
+            blocks.append(Block.from_lists(ks, vs))
 
-    def _create_db(self, path):
-        db = self._open_db(path, delete=True)
-        db.cursor().execute(
-            "CREATE TABLE key_index (key text, offset integer)")
-        return db
+        idx = self.get_idx(fname)
+        if os.path.isfile(idx):
+            os.unlink(idx)
+        db = sqlite3.connect(idx)
+        db.execute("CREATE TABLE postings (key TEXT, offs BLOB)")
+        total = 0
+        if blocks:
+            blk = Block.concat(blocks)
+            total = len(blk)
+            groups = segment.sort_and_group(blk)
+            sb = groups.block
+            starts, ends = groups.bounds()
+
+            def rows():
+                for i in range(len(starts)):
+                    k = sb.keys[starts[i]]
+                    offs = np.asarray(
+                        sb.values[starts[i]:ends[i]], dtype=np.int64)
+                    yield (k.item() if isinstance(k, np.generic) else k,
+                           offs.tobytes())
+
+            db.executemany("INSERT INTO postings VALUES (?, ?)", rows())
+            db.execute("CREATE INDEX postings_key ON postings (key)")
+        db.commit()
+        db.close()
+        return total
 
     def build(self, key_f, force=False):
         """Index every file under ``path``: ``key_f(line) -> iterable of
-        keys``.  Returns total keys indexed."""
+        keys``.  Returns the total postings indexed (same shape as the
+        reference: ``[(1, total)]``)."""
         paths = sorted(read_paths(self.path, False))
-
-        def index_file(fname):
-            log.debug("Indexing %s", fname)
-            db = self._create_db(fname)
-
-            def it():
-                offset = 0
-                with open(fname, "rb") as f:
-                    for raw in f:
-                        line = raw.decode("utf-8")
-                        for key in key_f(line):
-                            yield key, offset
-                        offset += len(raw)
-
-            c = db.cursor()
-            c.executemany("INSERT INTO key_index values (?, ?)", it())
-            db.commit()
-            c.execute("create index key_idx on key_index (key)")
-            db.commit()
-            c.execute("select count(*) from key_index")
-            count = c.fetchone()[0]
-            db.close()
-            return count
-
         return (Dampr.memory(paths)
                 .filter(lambda fname: force or not self.exists(fname))
-                .map(index_file)
+                .map(lambda fname: self._index_one(fname, key_f))
                 .fold_by(key=lambda _x: 1, binop=lambda x, y: x + y)
                 .read(name="indexing"))
 
-    def _seek_lines(self, query, params):
-        params = tuple(params)
-
-        def read_db(fname):
-            db = self._open_db(fname)
-            cur = db.cursor()
-            cur.execute(query, params)
-            with open(fname, "rb") as f:
-                for (offset,) in cur:
-                    f.seek(offset)
-                    yield f.readline().decode("utf-8")
+    # -- query -------------------------------------------------------------
+    def _offsets_for(self, fname, keys):
+        """Concatenated (with multiplicity) offset arrays of the matching
+        tokens — the vectorized analog of the reference's per-row scan."""
+        db = sqlite3.connect(self.get_idx(fname))
+        try:
+            marks = ",".join("?" for _ in keys)
+            rows = db.execute(
+                "SELECT offs FROM postings WHERE key IN ({})".format(marks),
+                tuple(keys)).fetchall()
+        finally:
             db.close()
+        if not rows:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(
+            [np.frombuffer(blob, dtype=np.int64) for (blob,) in rows])
+
+    def _seek_lines(self, select_offsets, keys):
+        keys = list(keys)
+
+        def read_matches(fname):
+            offs = select_offsets(self._offsets_for(fname, keys))
+            with open(fname, "rb") as f:
+                for off in offs.tolist():
+                    f.seek(off)
+                    yield f.readline().decode("utf-8")
 
         paths = sorted(read_paths(self.path, False))
-        return Dampr.memory(paths).flat_map(read_db)
+        return Dampr.memory(paths).flat_map(read_matches)
 
     def union(self, keys):
         """Lines containing any of the keys."""
         if not isinstance(keys, (list, tuple)):
             keys = [keys]
-        query = ("select distinct offset from key_index where key in ({}) "
-                 "order by offset asc").format(
-                     ",".join("?" for _ in keys))
-        return self._seek_lines(query, keys)
+        return self._seek_lines(np.unique, keys)
 
     def intersect(self, keys, min_match=None):
         """Lines containing at least ``min_match`` of the keys (all, by
@@ -108,8 +155,9 @@ class Indexer(object):
             min_match = len(keys)
         if isinstance(min_match, float):
             min_match = int(min_match * len(keys))
-        query = ("select offset from (select offset, count(*) as c from "
-                 "key_index where key in ({}) group by offset) where c >= ? "
-                 "order by offset asc").format(
-                     ",".join("?" for _ in keys))
-        return self._seek_lines(query, list(keys) + [min_match])
+
+        def at_least(offs, m=min_match):
+            uniq, counts = np.unique(offs, return_counts=True)
+            return uniq[counts >= m]
+
+        return self._seek_lines(at_least, keys)

@@ -1,0 +1,148 @@
+"""Composite (2D) numeric value lanes: type-uniform numeric tuples ride
+the segment kernels — mean's (sum, count) pair is the canonical user —
+with strict read-back fidelity for everything that doesn't qualify.
+"""
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.blocks import Block, _column_from_list, pylist
+
+
+class TestTupleColumn:
+    def test_key_columns_never_composite(self):
+        # tuple KEYS stay on the object lane (hash/sort machinery is
+        # lane-shaped); grouping by tuple keys must work end-to-end
+        out = dict(Dampr.memory(list(range(20)))
+                   .fold_by(key=lambda x: (x % 2, x % 3),
+                            binop=lambda a, b: a + b).read())
+        want = {}
+        for x in range(20):
+            k = (x % 2, x % 3)
+            want[k] = want.get(k, 0) + x
+        assert out == want
+
+    def test_lexicographic_min_over_tuple_values(self):
+        # a recognized binop (min) over tuple values means LEXICOGRAPHIC
+        # comparison, never elementwise 2D folding
+        data = [("k", (1, 5)), ("k", (2, 0)), ("j", (3, 3))]
+        out = dict(Dampr.memory(data)
+                   .fold_by(key=lambda kv: kv[0], binop=min,
+                            value=lambda kv: kv[1]).read())
+        assert out == {"k": (1, 5), "j": (3, 3)}
+
+    def test_topk_zero(self):
+        assert list(Dampr.memory(list(range(100))).topk(0).read()) == []
+
+
+    def test_int_pairs_build_2d(self):
+        col = _column_from_list([(1, 2), (3, 4), (5, 6)], composite=True)
+        assert col.ndim == 2 and col.dtype == np.int64
+        assert pylist(col) == [(1, 2), (3, 4), (5, 6)]
+
+    def test_float_triples_build_2d(self):
+        col = _column_from_list([(1.0, 2.0, 3.0), (4.0, 5.5, 6.0)],
+                                composite=True)
+        assert col.ndim == 2 and col.dtype == np.float64
+        assert pylist(col) == [(1.0, 2.0, 3.0), (4.0, 5.5, 6.0)]
+
+    @pytest.mark.parametrize("rows", [
+        [(0, 6.0), (1, 5.0)],          # mixed types: fidelity forbids 2D
+        [(True, 1), (False, 2)],       # bools can't ride numeric lanes
+        [(1, 2), (3, 4, 5)],           # ragged
+        [(2 ** 64, 1), (1, 2)],        # out of int64
+        [("a", 1), ("b", 2)],          # non-numeric
+        [(1,), (2,)],                  # width 1: plain tuples, not pairs
+    ])
+    def test_fidelity_cases_stay_object(self, rows):
+        col = _column_from_list(list(rows), composite=True)
+        assert col.dtype == object
+        assert pylist(col) == rows
+
+    def test_block_ops_on_composite(self):
+        ks = np.arange(100, dtype=np.int64) % 5
+        vs = np.stack([np.arange(100, dtype=np.int64),
+                       np.ones(100, dtype=np.int64)], axis=1)
+        blk = Block(ks, vs)
+        srt = blk.sort_by_hash()
+        assert srt.values.ndim == 2
+        parts = blk.split_by_partition(4)
+        back = Block.concat(list(parts.values()))
+        assert sorted(pylist(back.values)) == sorted(pylist(vs))
+
+
+class TestMean:
+    def test_int_mean_exact(self):
+        data = list(range(50000))
+        out = dict(Dampr.memory(data, partitions=8)
+                   .mean(key=lambda x: x % 7).read())
+        want = {k: sum(range(k, 50000, 7)) / float(len(range(k, 50000, 7)))
+                for k in range(7)}
+        assert out == want
+
+    def test_float_mean(self):
+        data = [x * 0.5 for x in range(20000)]
+        out = dict(Dampr.memory(data, partitions=8)
+                   .mean(key=lambda x: int(x) % 3).read())
+        for k, v in out.items():
+            vals = [x for x in data if int(x) % 3 == k]
+            assert v == pytest.approx(sum(vals) / len(vals), rel=1e-12)
+
+    def test_mean_pairs_ride_composite_lane(self):
+        # The (sum, count) pair must build a 2D lane, not per-record
+        # Python tuples on the object lane.
+        col = _column_from_list([(x, 1) for x in range(10)],
+                                composite=True)
+        assert col.ndim == 2
+
+    def test_huge_int_mean_falls_back_exactly(self):
+        # Values past int64 keep exact arithmetic via the object lane.
+        base = 2 ** 63
+        data = [base + i for i in range(100)]
+        out = dict(Dampr.memory(data).mean().read())
+        assert out == {1: sum(data) / float(len(data))}
+
+    def test_mean_under_tiny_budget(self):
+        from dampr_tpu.runner import MTRunner
+
+        data = list(range(30000))
+        pipe = Dampr.memory(data, partitions=8).mean(key=lambda x: x % 4)
+        pipe = pipe.checkpoint() if pipe.agg else pipe
+        runner = MTRunner("mean-tiny", pipe.pmer.graph,
+                          memory_budget=1 << 15)
+        out = runner.run([pipe.source])
+        got = dict(v for _k, v in out[0].read())
+        want = {k: sum(range(k, 30000, 4)) / float(len(range(k, 30000, 4)))
+                for k in range(4)}
+        assert got == want
+
+
+class TestTopkLen:
+    def test_topk_block_path_matches_oracle(self):
+        data = [((x * 7919) % 100003) for x in range(30000)]
+        got = list(Dampr.memory(data, partitions=8).topk(25).read())
+        # results read back key-sorted ascending (conformance-pinned:
+        # topk(2) of [1,3,2,4] is [3, 4])
+        want = sorted(sorted(data, reverse=True)[:25])
+        assert got == want
+
+    def test_topk_with_value_fn(self):
+        data = [("w%d" % i, i % 97) for i in range(5000)]
+        got = list(Dampr.memory(data, partitions=4)
+                   .topk(10, value=lambda kv: kv[1]).read())
+        assert [kv[1] for kv in got] == [96] * 10
+
+    def test_topk_strings(self):
+        data = ["s%05d" % ((x * 131) % 9001) for x in range(3000)]
+        got = list(Dampr.memory(data).topk(5).read())
+        assert got == sorted(sorted(data, reverse=True)[:5])
+
+    def test_len_block_and_stream_paths(self):
+        data = list(range(12345))
+        assert list(Dampr.memory(data).len().read()) == [12345]
+        assert list(Dampr.memory(data)
+                    .flat_map(lambda x: [x, x]).len().read()) == [24690]
+        # an empty collection still counts to [0] (one (1, 0) record —
+        # matches the reference's always-emitting map_count)
+        assert list(Dampr.memory([]).len().read()) == [0]
