@@ -182,10 +182,21 @@ def main():
     cold = run_dampr_tpu(corpus, ours_dir)
     log("dampr_tpu cold: {:.2f}s".format(cold))
     # warm steady-state: best of two runs (this box time-shares one core
-    # with unrelated tenants; a single sample is noise-prone)
-    secs = min(run_dampr_tpu(corpus, ours_dir),
-               run_dampr_tpu(corpus, ours_dir))
+    # with unrelated tenants; a single sample is noise-prone), with the
+    # wall-time split (device kernels / transfers / native codec) taken
+    # from the winning run
+    from dampr_tpu.ops import devtime
+
+    best = None
+    for _ in range(2):
+        devtime.reset()
+        t = run_dampr_tpu(corpus, ours_dir)
+        if best is None or t < best[0]:
+            best = (t, devtime.snapshot())
+    secs, split = best
     log("dampr_tpu warm: {:.2f}s = {:.1f} MB/s".format(secs, size_mb / secs))
+    log("wall split: device {:.2f}s, transfer {:.2f}s, codec {:.2f}s".format(
+        split["device"], split["transfer"], split["codec"]))
 
     n = check_result(ours_dir, counter, total)
     log("verified {} idf entries match baseline exactly".format(n))
@@ -196,6 +207,15 @@ def main():
         "value": round(value, 2),
         "unit": "MB/s",
         "vs_baseline": round(value / (size_mb / base_secs), 2),
+        # Thread-seconds per wall second for the winning warm run (see
+        # ops/devtime.py): device kernel dispatch+sync, host<->device
+        # transfers, the native C codec.  Utilization-style — concurrent
+        # pool workers sum, so a value can exceed 1.0 on multi-core
+        # hosts (2.0 = two cores' worth).  The single-chip claim made
+        # explicit: everything else is generic host Python/numpy.
+        "device_fraction": round(split["device"] / secs, 4),
+        "transfer_fraction": round(split["transfer"] / secs, 4),
+        "codec_fraction": round(split["codec"] / secs, 4),
     }))
 
 

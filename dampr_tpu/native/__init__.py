@@ -34,6 +34,21 @@ def _build():
         subprocess.run(cmd, check=True, capture_output=True)
 
 
+def _codec_timed(fn):
+    """Charge this native-codec entry point's wall time to the 'codec'
+    devtime bucket (the bench's host/device split)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        from ..ops import devtime
+
+        with devtime.track("codec"):
+            return fn(*a, **kw)
+
+    return wrapped
+
+
 def get_lib():
     """The loaded native library, or None when unavailable/disabled."""
     global _lib, _tried
@@ -94,6 +109,7 @@ def get_lib():
     return _lib
 
 
+@_codec_timed
 def tokenize_hash(buf, mode, lower, want_line_ids=False):
     """One native pass: (starts, lens, h1, h2[, line_ids]) for a uint8 buffer.
     Returns None when the native library is unavailable."""
@@ -119,6 +135,7 @@ def tokenize_hash(buf, mode, lower, want_line_ids=False):
     return out
 
 
+@_codec_timed
 def parse_i64(buf):
     """Whitespace-separated int64 parse of a uint8 buffer in one C pass.
     Returns an int64 array, None when the native library is unavailable,
@@ -139,6 +156,7 @@ def parse_i64(buf):
     return out[:count].copy()
 
 
+@_codec_timed
 def hash_bytes_batch(bs):
     """Dual-lane FNV over a list of bytes keys in one C pass.  Returns
     (h1, h2) uint32 arrays, or None when the native library is
@@ -159,6 +177,7 @@ def hash_bytes_batch(bs):
     return h1, h2
 
 
+@_codec_timed
 def token_counts(buf, mode, lower, dedup_per_line):
     """Fused native tokenize+hash+count: one pass, no sort.  Returns
     (h1, h2, counts, rep_starts, rep_lens) over distinct tokens, or None when

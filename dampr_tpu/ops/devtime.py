@@ -1,0 +1,54 @@
+"""Wall-time attribution of device work (the bench's ``device_fraction``).
+
+Three buckets, accumulated process-wide behind one lock:
+
+- ``device``:   jitted kernel dispatch+result sites (segment folds, the
+                hash lexsort, mesh collective programs);
+- ``transfer``: explicit host<->device lane movement (HBM tier puts,
+                value-lane fetches, final fold-result fetches);
+- ``codec``:    the native C text/hash/parse codec (host, but worth
+                separating from generic Python time).
+
+Times are dispatch-site THREAD-seconds: concurrent pool workers each
+add their own elapsed time, so a bucket divided by wall time reads like
+CPU utilization (2.0 = two cores' worth per wall second) and can exceed
+1.0 on multi-core hosts — same convention as `top`.  A jax call that
+returns an unrealized array charges its sync cost to whichever site
+forces it (usually a ``transfer`` fetch).  Attribution-accurate at the
+boundaries users can act on, not a profiler-grade kernel timeline (use
+settings.profile_dir -> jax.profiler for that).
+"""
+
+import contextlib
+import threading
+import time
+
+_lock = threading.Lock()
+_counters = {"device": 0.0, "transfer": 0.0, "codec": 0.0}
+
+
+@contextlib.contextmanager
+def track(kind):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            _counters[kind] += dt
+
+
+def add(kind, seconds):
+    with _lock:
+        _counters[kind] += seconds
+
+
+def snapshot():
+    with _lock:
+        return dict(_counters)
+
+
+def reset():
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0.0

@@ -110,14 +110,17 @@ def hash_sort_perm(h1, h2):
     """Return the stable permutation sorting records by (h1, h2)."""
     n = len(h1)
     if settings.use_device_for(n):
+        from . import devtime
+
         npad = _pow2(n)
         valid = np.zeros(npad, dtype=np.uint8)
         if npad != n:
             valid[n:] = 1
             h1 = np.pad(h1, (0, npad - n))
             h2 = np.pad(h2, (0, npad - n))
-        _, _, perm = _lexsort_jit()(valid, h1, h2)
-        return np.asarray(perm)[:n]
+        with devtime.track("device"):
+            _, _, perm = _lexsort_jit()(valid, h1, h2)
+            return np.asarray(perm)[:n]
     return np.lexsort((h2, h1)).astype(np.int32)
 
 
@@ -396,6 +399,7 @@ def fold_sorted(groups, op):
                 # (_device_fold_exact guaranteed representability).
                 if vals.dtype == np.int64:
                     vals = vals.astype(np.int32)
+            from . import devtime
             seg_ids = np.repeat(np.arange(ng, dtype=np.int64), ends - starts)
             npad = _pow2(n)
             ng_pad = _pow2(ng)
@@ -405,8 +409,9 @@ def fold_sorted(groups, op):
                 pad_spec = ((0, npad - n), (0, 0)) if vals.ndim == 2 else (0, npad - n)
                 vals = np.pad(vals, pad_spec, constant_values=pad_val)
                 seg_ids = np.pad(seg_ids, (0, npad - n), constant_values=ng_pad - 1)
-            folded = np.asarray(
-                _segment_fold_jit(op.kind, ng_pad)(vals, seg_ids.astype(np.int32)))[:ng]
+            with devtime.track("device"):
+                folded = np.asarray(
+                    _segment_fold_jit(op.kind, ng_pad)(vals, seg_ids.astype(np.int32)))[:ng]
             # padding contributed only to the last (possibly real) segment when
             # ng == ng_pad and op == sum with pad 0 / min with inf — safe by
             # construction of pad values going to segment ng_pad-1 only if

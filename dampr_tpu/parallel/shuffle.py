@@ -361,6 +361,8 @@ def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
     double on overflow."""
     import jax
 
+    from ..ops import devtime
+
     capacity = max(8, int(-(-n_local // n_dev) * factor))
     axis = settings.mesh_axis
     gather = jax.process_count() > 1
@@ -368,8 +370,10 @@ def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
         prog = _build_fold_program(mesh, n_dev, n_local, capacity, kind,
                                    np.dtype(v.dtype).name, axis, nonneg,
                                    gather)
-        fh1, fh2, fv, ok, dropped = prog(h1, h2, v, valid)
-        if int(dropped) == 0:
+        with devtime.track("device"):
+            fh1, fh2, fv, ok, dropped = prog(h1, h2, v, valid)
+            dropped = int(dropped)
+        if dropped == 0:
             return fh1, fh2, fv, ok
         capacity *= 2
 

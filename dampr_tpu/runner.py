@@ -316,15 +316,22 @@ class OutputDataset(Dataset):
         below it via searchsorted, and stable-sorts only that slice —
         replacing per-record Python heap merging.  Returns None (fall back to
         the record merge) when any partition's keys are non-numeric."""
-        parts = []
-        for pid in pids:
-            refs = self.pset.refs(pid)
-            if any(getattr(r, "key_dtype", np.dtype(object)) == object
-                   for r in refs):
-                return None
-            blk = self._sorted_partition_block(pid)
-            if blk is not None:
-                parts.append(blk)
+        all_refs = [r for pid in pids for r in self.pset.refs(pid)]
+        if any(getattr(r, "key_dtype", np.dtype(object)) == object
+               for r in all_refs):
+            return None
+        # Per-partition sorts run on the pool: numpy's sort kernels release
+        # the GIL, so multi-core hosts get near-linear speedup on the read
+        # phase's dominant cost (this bench box has one core; the path is
+        # exercised by the multi-core CI rig either way).
+        workers = max(1, min(settings.max_processes, len(pids)))
+        if workers > 1:
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                sorted_parts = list(pool.map(self._sorted_partition_block,
+                                             pids))
+        else:
+            sorted_parts = [self._sorted_partition_block(p) for p in pids]
+        parts = [blk for blk in sorted_parts if blk is not None]
         if not parts:
             return iter(())
 
@@ -823,7 +830,8 @@ class MTRunner(object):
                 push(builder.flush())
             return end()
 
-        return job, combine_op, pin, feeds_reduce, new_sink, feeds_device_fold
+        return (job, combine_op, pin, feeds_reduce, new_sink,
+                feeds_device_fold)
 
     def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True,
                             device=False):
@@ -1609,6 +1617,36 @@ class MTRunner(object):
                 log.info("Stage %s resumed: %s", sid + 1, st.as_dict())
                 continue
             if isinstance(stage, GMap):
+                if (sid not in fused
+                        and len(stage.inputs) == 1
+                        and type(stage.mapper) is base.Map
+                        and stage.mapper.mapper is base._identity
+                        and stage.combiner is None
+                        and "binop" not in stage.options
+                        and not stage.options.get("memory")
+                        and not self.resume
+                        and stage.inputs[0] not in outputs
+                        and isinstance(env[stage.inputs[0]],
+                                       storage.PartitionSet)
+                        and env[stage.inputs[0]].n_partitions
+                        == self.n_partitions):
+                    # Identity checkpoint over an already-materialized
+                    # partition set: alias it instead of re-registering
+                    # (and re-spilling) every byte through a copy stage.
+                    # The alias takes over deletion duty from the input.
+                    result = env[stage.inputs[0]]
+                    nrec, njobs = result.total_records(), 0
+                    if stage.inputs[0] in to_delete:
+                        to_delete.remove(stage.inputs[0])
+                    env[stage.output] = result
+                    to_delete.append(stage.output)
+                    st = StageStats(sid, "map-alias")
+                    st.records_out = nrec
+                    st.seconds = time.time() - t0
+                    self.stats.append(st)
+                    log.info("Stage %s aliased (identity checkpoint): %s",
+                             sid + 1, st.as_dict())
+                    continue
                 if sid in fused:
                     result, nrec, njobs = fused.pop(sid)
                 else:
@@ -1678,6 +1716,10 @@ class MTRunner(object):
                 if source in keep:
                     continue
                 entry = env.get(source)
+                if any(env.get(k) is entry for k in keep):
+                    # identity-checkpoint alias of a kept output: the
+                    # PartitionSet is shared, deletion would empty both
+                    continue
                 if isinstance(entry, storage.PartitionSet):
                     if self.resume and source not in volatile_sources:
                         # Durable runs keep intermediate checkpoints on disk

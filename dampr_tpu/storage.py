@@ -122,10 +122,13 @@ class BlockRef(object):
         routing metadata."""
         import jax
 
+        from .ops import devtime
+
         h1, h2 = block.hashes()
         lane_vals, self.lane_abs, self.lane_min = prep
-        self._dev = (jax.device_put(lane_vals), jax.device_put(h1),
-                     jax.device_put(h2))
+        with devtime.track("transfer"):
+            self._dev = (jax.device_put(lane_vals), jax.device_put(h1),
+                         jax.device_put(h2))
         self.dev_bytes = lane_vals.nbytes + h1.nbytes + h2.nbytes
         self._kmeta = (block.keys, h1, h2)
         self._block = None
@@ -207,8 +210,11 @@ class BlockRef(object):
                 # Host materialization of a device-resident block: one
                 # value-lane fetch (counted — the HBM tier's whole point is
                 # that device-fold reduces never take this path).
-                vals = np.asarray(self._dev[0]).astype(
-                    self.value_dtype, copy=False)
+                from .ops import devtime
+
+                with devtime.track("transfer"):
+                    vals = np.asarray(self._dev[0]).astype(
+                        self.value_dtype, copy=False)
                 if self.store is not None:
                     self.store.count_d2h(vals.nbytes)
                 keys, h1, h2 = self._kmeta

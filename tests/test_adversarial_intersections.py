@@ -1,0 +1,100 @@
+"""Adversarial feature-INTERSECTION tests: the remaining bugs live where
+subsystems compose, so every case here runs several at once — random
+pipelines x tiny memory budget x forced mesh exchange/fold x HBM tier x
+resume interrupt/rerun — asserting byte-exactness against the pure-Python
+oracle (the same generators as test_property_random).
+"""
+
+import random
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from test_property_random import _CHAIN_OPS, _TERMINALS, _gen_data
+
+
+@pytest.fixture(autouse=True)
+def crank_everything(tmp_path):
+    old = (settings.partitions, settings.mesh_fold, settings.mesh_exchange,
+           settings.hbm_budget, settings.hbm_min_records,
+           settings.scratch_root)
+    settings.partitions = 8
+    settings.mesh_fold = "on"
+    settings.mesh_exchange = "on"
+    settings.hbm_budget = 1 << 20
+    settings.hbm_min_records = 1
+    settings.scratch_root = str(tmp_path)
+    yield
+    (settings.partitions, settings.mesh_fold, settings.mesh_exchange,
+     settings.hbm_budget, settings.hbm_min_records,
+     settings.scratch_root) = old
+
+
+def _build_case(seed):
+    rng = random.Random(seed)
+    data = _gen_data(rng)
+    oracle = list(data)
+    chain = [rng.choice(_CHAIN_OPS)(rng) for _ in range(rng.randrange(0, 4))]
+    terminal = rng.choice(_TERMINALS)(rng)
+    for _eng, orc, _t in chain:
+        oracle = orc(oracle)
+    want = terminal[1](oracle)
+
+    def build(extra=None):
+        pipe = Dampr.memory(list(data), partitions=rng.choice([2, 5, 8]))
+        for eng, _orc, _t in chain:
+            pipe = eng(pipe)
+        if extra is not None:
+            pipe = extra(pipe)
+        return terminal[0](pipe)
+
+    return build, want
+
+
+class TestPressureMeshHBM:
+    """Tiny budget x forced mesh paths x HBM tier, random pipelines."""
+
+    @pytest.mark.parametrize("seed", range(0, 40, 2))
+    def test_exact_under_all_pressure(self, seed):
+        build, want = _build_case(seed)
+        got = list(build().run("adv-%d" % seed,
+                               memory_budget=1 << 14).read())
+        assert sorted(map(repr, got)) == sorted(map(repr, want)), seed
+
+
+class TestResumeInterruptions:
+    """Crash mid-run, then rerun under the same name: completed stages
+    resume, the crashed stage recomputes, results stay exact."""
+
+    @pytest.mark.parametrize("seed", range(0, 30, 3))
+    def test_bomb_then_rerun(self, seed):
+        build, want = _build_case(seed)
+        bomb = {"armed": True}
+
+        def fuse_stage(pipe, bomb=bomb):
+            def maybe_explode(x):
+                if bomb["armed"]:
+                    raise RuntimeError("injected failure")
+                return x
+
+            return pipe.map(maybe_explode)
+
+        name = "adv-resume-%d" % seed
+        with pytest.raises(Exception):
+            build(extra=fuse_stage).run(name, resume=True,
+                                        memory_budget=1 << 14).read()
+        bomb["armed"] = False
+        got = list(build(extra=fuse_stage).run(
+            name, resume=True, memory_budget=1 << 14).read())
+        assert sorted(map(repr, got)) == sorted(map(repr, want)), seed
+
+    @pytest.mark.parametrize("seed", range(1, 30, 3))
+    def test_rerun_resumes_exactly(self, seed):
+        build, want = _build_case(seed)
+        name = "adv-rerun-%d" % seed
+        first = list(build().run(name, resume=True,
+                                 memory_budget=1 << 14).read())
+        second = list(build().run(name, resume=True,
+                                  memory_budget=1 << 14).read())
+        assert sorted(map(repr, first)) == sorted(map(repr, want)), seed
+        assert sorted(map(repr, second)) == sorted(map(repr, first)), seed

@@ -15,19 +15,38 @@ def small_partitions():
 
 class TestGovernor:
     def test_partition_ref_count_bounded(self):
+        # The governor bounds every materialized stage's ref counts
+        # (reduce fan-in and read-side file counts alike).
+        import numpy as np
+
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.runner import MTRunner
+        from dampr_tpu.storage import PartitionSet
+
         old = settings.max_files_per_stage
         settings.max_files_per_stage = 3
         try:
-            # 40 memory chunks -> up to 40 refs per partition without the
-            # governor; with it, each partition compacts to one ref.
-            pipe = (Dampr.memory(list(range(400)), partitions=40)
-                    .checkpoint(True))
-            from dampr_tpu.runner import MTRunner
-            runner = MTRunner("govern", pipe.pmer.graph)
-            out = runner.run([pipe.source])
-            pset = out[0].pset
+            runner = MTRunner("govern", Dampr.memory([1]).pmer.graph)
+            pset = PartitionSet(2)
+            for i in range(40):
+                blk = Block(np.arange(10, dtype=np.int64) + 10 * i,
+                            np.arange(10, dtype=np.int64))
+                for pid, sub in blk.split_by_partition(2).items():
+                    pset.add(pid, runner.store.register(sub))
+            runner._compact_partitions(pset, None, False, feeds_reduce=True)
             assert all(len(refs) <= 3 for refs in pset.parts.values())
+
+            # end-to-end: a REDUNDANT identity checkpoint (input already
+            # a materialized PartitionSet) ALIASES instead of copying,
+            # and results stay exact
+            pipe = (Dampr.memory(list(range(400)), partitions=40)
+                    .checkpoint(True)
+                    .checkpoint(True))
+            r2 = MTRunner("govern2", pipe.pmer.graph)
+            out = r2.run([pipe.source])
             assert sorted(v for _k, v in out[0].read()) == list(range(400))
+            assert any(s.kind == "map-alias" for s in r2.stats), (
+                "identity checkpoint was not aliased")
         finally:
             settings.max_files_per_stage = old
 
@@ -117,3 +136,16 @@ class TestTinyStageCollapse:
         finally:
             settings.small_stage_bytes = old
         assert got == want
+
+
+class TestAliasOwnership:
+    def test_requested_input_and_checkpoint_both_readable(self):
+        # x and its identity checkpoint y both requested: they must NOT
+        # share a PartitionSet (deleting one would empty the other), so
+        # the alias fast path must stand down.
+        x = Dampr.memory(list(range(50))).map(lambda v: v + 1).checkpoint()
+        y = x.checkpoint(True)
+        outs = Dampr.run(x, y)
+        assert sorted(outs[0].stream()) == list(range(1, 51))
+        outs[0].delete()
+        assert sorted(outs[1].stream()) == list(range(1, 51))
