@@ -98,6 +98,13 @@ def main():
         "budget_mb": args.budget_mb,
         "spills": runner.store.spill_count,
         "spilled_mb": round(runner.store.spilled_bytes / 1e6, 1),
+        # Spill-lean merge planning evidence: generations == 0 means the
+        # final read fed straight from first-level runs (write
+        # amplification ~1x); each generation past that re-spills the data
+        # once through the streamed file->file merge.
+        "merge_generations": runner.store.merge_gens,
+        "merge_gen_mb": round(runner.store.merge_gen_bytes / 1e6, 1),
+        "sorted_runs": bool(out[0].pset.key_sorted_runs),
     }))
 
 

@@ -269,10 +269,12 @@ def _concat_cols(cols):
         dtypes = {c.dtype for c in cols}
         if len(dtypes) == 1:
             return np.concatenate(cols)
-        for c in cols:
-            if c.dtype == np.int64 and len(c) and np.abs(c).max() > 2 ** 53:
-                return _as_object_concat(cols)
-        return np.concatenate([c.astype(np.float64) for c in cols])
+        # Mixed-dtype composite lanes box back to tuples on the object lane:
+        # _tuple_column promises strict type fidelity, so an int tuple
+        # (1, 2) must never read back as (1.0, 2.0) after compaction with a
+        # float-tuple block (the reference's pickled streams preserve the
+        # types exactly).
+        return _as_object_concat(cols)
     dtypes = {c.dtype for c in cols}
     if len(dtypes) == 1 and object not in dtypes:
         return np.concatenate(cols)
@@ -307,6 +309,124 @@ def _as_object_concat(cols):
             out[at: at + len(c)] = [x.item() for x in c]
         at += len(c)
     return out
+
+
+def merge_sorted_streams(streams):
+    """Vectorized k-way merge over streams of KEY-sorted blocks.
+
+    Each stream's concatenated key sequence must be non-decreasing (a
+    spilled sorted run read back window by window) and NaN-free — NaN
+    poisons the bound comparisons, so run registration (try_sorted_run)
+    rejects NaN keys up front.  Memory holds one
+    in-flight window per stream — never a whole run — so merging hundreds
+    of spilled runs stays budget-bounded while every run file is read
+    strictly sequentially.
+
+    Round structure: the *bound* is the smallest last-key among the
+    streams' current windows.  Every record ``<= bound`` anywhere is
+    already buffered (later windows of any stream hold only keys
+    ``>= their predecessor's last``), so each round gathers those records,
+    stable-sorts the gathered slice, and emits it — at least one full
+    window per round, so rounds number O(total windows).  A stream whose
+    window ends exactly at the bound extends through ties: its next
+    window(s)' ``== bound`` prefixes append straight to the round's
+    output (never re-buffered or re-concatenated), so equal keys do not
+    straddle an emission boundary and ties across streams keep stream
+    order (stable sort over the gathered concat).  One exception keeps
+    the memory bound honest: a giant tie group (one key spanning more
+    bytes than a quarter of the stage budget in extension windows) stops
+    extending and drains over subsequent rounds — the emitted key
+    sequence stays non-decreasing, only tie ORDER degrades, and memory
+    never exceeds the per-round budget plus one window per stream.
+    """
+    from . import settings
+
+    its = [iter(s) for s in streams]
+    n = len(its)
+
+    def slice_of(blk, a, b):
+        return Block(
+            blk.keys[a:b], blk.values[a:b],
+            None if blk.h1 is None else blk.h1[a:b],
+            None if blk.h2 is None else blk.h2[a:b])
+
+    def gen():
+        buf = [None] * n  # current (trimmed) window per stream
+        last = [None] * n  # python-scalar last key per buffer
+
+        def load(i):
+            while True:
+                try:
+                    b = next(its[i])
+                except StopIteration:
+                    buf[i] = None
+                    last[i] = None
+                    return
+                if len(b):
+                    buf[i] = b
+                    k = b.keys[-1]
+                    last[i] = k.item() if isinstance(k, np.generic) else k
+                    return
+
+        for i in range(n):
+            load(i)
+        while True:
+            bound = None
+            for i in range(n):
+                if buf[i] is not None and (bound is None or last[i] < bound):
+                    bound = last[i]
+            if bound is None:
+                return
+            pieces = []
+            ext_budget = max(settings.max_memory_per_stage // 4, 1 << 20)
+            for i in range(n):
+                b = buf[i]
+                if b is None:
+                    continue
+                end = int(np.searchsorted(b.keys, bound, side="right"))
+                if end < len(b):
+                    if end:
+                        pieces.append(slice_of(b, 0, end))
+                        buf[i] = slice_of(b, end, len(b))
+                    continue  # last[i] unchanged: still this window's last
+                # Window consumed (last[i] == bound): emit it whole and
+                # extend through ties — the stream's NEXT window(s) may
+                # continue the same key.  Their ``== bound`` prefixes go
+                # straight into the output pieces (no re-buffering), the
+                # first ``> bound`` suffix becomes the new window.
+                pieces.append(b)
+                buf[i] = None
+                last[i] = None
+                while True:
+                    try:
+                        nxt = next(its[i])
+                    except StopIteration:
+                        break  # stream exhausted mid-tie
+                    if not len(nxt):
+                        continue
+                    e2 = int(np.searchsorted(nxt.keys, bound, side="right"))
+                    if e2:
+                        p = slice_of(nxt, 0, e2)
+                        pieces.append(p)
+                        ext_budget -= p.nbytes()
+                    if e2 < len(nxt):
+                        buf[i] = slice_of(nxt, e2, len(nxt))
+                        k = buf[i].keys[-1]
+                        last[i] = (k.item()
+                                   if isinstance(k, np.generic) else k)
+                        break
+                    if ext_budget <= 0:
+                        # Giant tie group: stop extending so the round's
+                        # emission stays budget-bounded.  The key's
+                        # remaining records drain over the next round(s)
+                        # (same bound) — order holds, tie order degrades.
+                        load(i)
+                        break
+            merged = Block.concat(pieces)
+            if len(merged):
+                yield merged.take(np.argsort(merged.keys, kind="stable"))
+
+    return gen()
 
 
 class BlockBuilder(object):

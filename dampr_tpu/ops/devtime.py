@@ -1,22 +1,44 @@
 """Wall-time attribution of device work (the bench's ``device_fraction``).
 
-Three buckets, accumulated process-wide behind one lock:
+Buckets, accumulated process-wide behind one lock:
 
 - ``device``:   jitted kernel dispatch+result sites (segment folds, the
                 hash lexsort, mesh collective programs);
 - ``transfer``: explicit host<->device lane movement (HBM tier puts,
                 value-lane fetches, final fold-result fetches);
 - ``codec``:    the native C text/hash/parse codec (host, but worth
-                separating from generic Python time).
+                separating from generic Python time);
+- ``codec_wait``: WALL-CLOCK union of intervals during which EVERY live
+                map slot was blocked on its codec — each slot's fold
+                consumer waiting for the next block while that slot's
+                producer thread was inside the native codec (the overlap
+                executor, runner._overlap_stream, via slot_stall/
+                slot_unstall below).  This is the codec time still on
+                the engine's critical path after overlapping: whenever
+                at least one slot is folding, the codec seconds
+                elsewhere are covered by useful work and do NOT count.
+                Consumer wait caused by producer-side IO or Python
+                (window reads, block building) is not codec-
+                attributable and is excluded, matching what the
+                ``codec`` bucket itself counts.  With the overlap
+                executor off there are no slots and the bucket stays 0;
+                the serial non-overlapped codec cost is then the whole
+                ``codec`` bucket, since the job thread that runs the
+                codec is by construction not folding meanwhile.
 
-Times are dispatch-site THREAD-seconds: concurrent pool workers each
-add their own elapsed time, so a bucket divided by wall time reads like
-CPU utilization (2.0 = two cores' worth per wall second) and can exceed
-1.0 on multi-core hosts — same convention as `top`.  A jax call that
-returns an unrealized array charges its sync cost to whichever site
-forces it (usually a ``transfer`` fetch).  Attribution-accurate at the
-boundaries users can act on, not a profiler-grade kernel timeline (use
-settings.profile_dir -> jax.profiler for that).
+Times are dispatch-site THREAD-seconds (``codec_wait`` excepted — it is
+a wall-clock interval union, never exceeding elapsed wall): concurrent
+pool workers each add their own elapsed time, so a bucket divided by
+wall time reads like CPU utilization (2.0 = two cores' worth per wall
+second) and can exceed 1.0 on multi-core hosts — same convention as
+`top`.  Thread-seconds inside ``track`` regions include any GIL waits
+the region suffers, so under core contention the ``codec`` bucket is an
+UPPER bound on codec CPU — one more reason the critical-path question
+needs the interval-union bucket.  A jax call that returns an unrealized
+array charges its sync cost to whichever site forces it (usually a
+``transfer`` fetch).  Attribution-accurate at the boundaries users can
+act on, not a profiler-grade kernel timeline (use settings.profile_dir
+-> jax.profiler for that).
 """
 
 import contextlib
@@ -24,18 +46,97 @@ import threading
 import time
 
 _lock = threading.Lock()
-_counters = {"device": 0.0, "transfer": 0.0, "codec": 0.0}
+_counters = {"device": 0.0, "transfer": 0.0, "codec": 0.0,
+             "codec_wait": 0.0}
+_active = {}  # (thread ident, kind) -> nesting depth inside track(kind)
+
+# codec_wait state: live overlap slots vs slots currently blocked on
+# their own producer's codec.  The union interval is open exactly while
+# every live slot is stalled (_all_since is its start timestamp).
+_slots = 0
+_stalled = 0
+_all_since = None
+
+
+def _roll_union_locked():
+    """Close/open the all-slots-stalled interval after a state change."""
+    global _all_since
+    all_stalled = _slots > 0 and _stalled >= _slots
+    if _all_since is None and all_stalled:
+        _all_since = time.perf_counter()
+    elif _all_since is not None and not all_stalled:
+        _counters["codec_wait"] += time.perf_counter() - _all_since
+        _all_since = None
+
+
+def slot_enter():
+    """A map slot's overlapped fold consumer came alive."""
+    global _slots
+    with _lock:
+        _slots += 1
+        _roll_union_locked()
+
+
+def slot_exit():
+    global _slots
+    with _lock:
+        _slots -= 1
+        _roll_union_locked()
+
+
+def slot_stall():
+    """This slot's consumer is blocked waiting while its producer is in
+    the native codec."""
+    global _stalled
+    with _lock:
+        _stalled += 1
+        _roll_union_locked()
+
+
+def slot_unstall():
+    global _stalled
+    with _lock:
+        _stalled -= 1
+        _roll_union_locked()
 
 
 @contextlib.contextmanager
 def track(kind):
     t0 = time.perf_counter()
+    if kind != "codec":
+        # Only codec regions feed active_in() (the overlap executor's
+        # stall attribution); device/transfer sites skip the entry lock
+        # and the _active bookkeeping — one lock take on exit, as before
+        # the overlap work landed.
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with _lock:
+                _counters[kind] += dt
+        return
+    key = (threading.get_ident(), kind)
+    with _lock:
+        _active[key] = _active.get(key, 0) + 1
     try:
         yield
     finally:
         dt = time.perf_counter() - t0
         with _lock:
+            depth = _active.get(key, 1) - 1
+            if depth:
+                _active[key] = depth
+            else:
+                _active.pop(key, None)
             _counters[kind] += dt
+
+
+def active_in(thread_ident, kind):
+    """Is the given thread currently inside ``track(kind)``?  Lets a
+    waiter attribute its blocked time to the SPECIFIC producer it waits
+    on (a consumer blocked on its own job's codec, not a sibling job's)."""
+    with _lock:
+        return _active.get((thread_ident, kind), 0) > 0
 
 
 def add(kind, seconds):
@@ -45,10 +146,16 @@ def add(kind, seconds):
 
 def snapshot():
     with _lock:
-        return dict(_counters)
+        out = dict(_counters)
+        if _all_since is not None:  # fold in the open stall interval
+            out["codec_wait"] += time.perf_counter() - _all_since
+        return out
 
 
 def reset():
+    global _all_since
     with _lock:
         for k in _counters:
             _counters[k] = 0.0
+        if _all_since is not None:  # an open interval restarts at zero
+            _all_since = time.perf_counter()

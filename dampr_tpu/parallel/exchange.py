@@ -38,7 +38,7 @@ import pickle
 import numpy as np
 
 from .. import settings
-from .mesh import mesh_size
+from .mesh import mesh_size, shard_map as _shard_map
 from .shuffle import _pad_pow2
 
 
@@ -72,7 +72,7 @@ def _build_exchange(mesh, axis, capacity, gather=False):
         kwargs["check_vma"] = False
 
     def program(bb, ln):
-        return jax.shard_map(
+        return _shard_map(
             per_device, mesh=mesh,
             in_specs=(P(axis), P(axis)),
             out_specs=(out_spec, out_spec), **kwargs)(bb, ln)

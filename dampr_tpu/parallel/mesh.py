@@ -64,3 +64,22 @@ def init_distributed(coordinator_address=None, num_processes=None,
     if process_id is not None:
         kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kwargs):
+    """``jax.shard_map`` across jax versions.  Newer jax exposes it
+    top-level with the vma-typed replication check (``check_vma``); 0.4.x
+    ships ``jax.experimental.shard_map`` with ``check_rep`` instead.
+    Callers always pass the new-API kwargs; the legacy spelling is mapped
+    here so every mesh program has exactly one compatibility seam."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kwargs)

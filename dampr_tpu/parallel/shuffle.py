@@ -33,7 +33,7 @@ import functools
 import numpy as np
 
 from .. import settings
-from .mesh import mesh_size
+from .mesh import mesh_size, shard_map as _shard_map
 
 _INVALID_SLOT_PAD = 1  # extra scatter slot that swallows dropped writes
 
@@ -219,7 +219,7 @@ def _build_fold_program(mesh, n_dev, n_local, capacity, kind, v_dtype_name,
             # all_gather output IS replicated; the varying-axes inference
             # can't prove it, so disable the check for this variant.
             kwargs["check_vma"] = False
-        return jax.shard_map(
+        return _shard_map(
             per_device,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -462,7 +462,7 @@ def mesh_global_sum(mesh, v):
     def per_device(x):
         return jax.lax.psum(jnp.sum(x), axis)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         per_device, mesh=mesh,
         in_specs=(P(axis),), out_specs=P()))(pv)
     return np.asarray(out).item()

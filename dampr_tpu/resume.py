@@ -447,7 +447,12 @@ def persist_stage(store, sid, fp, result, nrec):
                                str(ref.key_dtype), str(ref.value_dtype)])
         manifest = {"fp": fp, "kind": "pset",
                     "n_partitions": result.n_partitions,
-                    "blocks": blocks, "nrec": nrec}
+                    "blocks": blocks, "nrec": nrec,
+                    # provenance flags survive the round-trip so a resumed
+                    # output keeps its fast read/alias paths
+                    "flags": [bool(result.hash_routed),
+                              bool(result.hash_sorted),
+                              bool(result.key_sorted_runs)]}
     else:  # raw tap handles pass through _run untouched; nothing to persist
         return
     old_paths = _manifest_files(root, sid)
@@ -629,7 +634,9 @@ def restore_stage(root, manifest):
 
     if manifest["kind"] == "sink":
         return _SinkOutput(manifest["paths"]), manifest["nrec"]
-    pset = PartitionSet(manifest["n_partitions"])
+    flags = manifest.get("flags", [False, False, False])
+    pset = PartitionSet(manifest["n_partitions"], hash_routed=flags[0],
+                        hash_sorted=flags[1], key_sorted_runs=flags[2])
     for pid, rel, nrecords, nbytes, kdt, vdt in manifest["blocks"]:
         pset.add(pid, BlockRef.from_disk(
             os.path.join(root, rel), nrecords, nbytes, kdt, vdt))

@@ -28,6 +28,8 @@ import copy
 import itertools
 import logging
 import os
+import queue as _queue
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -89,6 +91,153 @@ def _exchange_mesh_gate(budget):
     D = mesh_size(mesh)
     window = max(1 << 18, budget // (8 * D * D))
     return mesh, D, window
+
+
+def _overlap_stream(items, store, size_of=None):
+    """The stage-overlapped streaming executor's pipe: run ``items`` — the
+    codec, a generator whose ``next()`` does the decompress/tokenize/parse
+    work — on a dedicated producer thread that stays up to
+    ``settings.overlap_windows`` produced blocks ahead of the consumer (the
+    fold/register loop on the job thread).  This extends the readahead
+    pattern ``inputs.Readahead`` applies to raw chunk bytes up through the
+    codec: while the current block folds, the next window is already being
+    tokenized.
+
+    Memory discipline: every in-flight block is charged byte-for-byte
+    against the run's budget (``store.reserve_overlap``) from the moment
+    the codec emits it until the consumer has finished folding it, so
+    readahead displaces resident refs (they spill) instead of stacking on
+    top of the stage ceiling.  The charge is released in a ``finally`` on
+    both sides — consumer abandonment (a failed fold mid-window, a retried
+    job) stops the producer and drains every outstanding reservation, so a
+    killed window can never leak budget.
+
+    Critical-path accounting: while this consumer blocks on the queue
+    with its producer inside the native codec, the slot is marked
+    stalled (devtime.slot_stall); the ``codec_wait`` bucket accumulates
+    the WALL-CLOCK union of intervals where every live slot is stalled
+    at once — the codec seconds no fold anywhere could cover, i.e. the
+    codec time still on the engine's critical path after overlapping.
+    (With overlap off the job thread runs the codec itself, so the whole
+    ``codec`` bucket is non-overlapped by construction.)
+
+    Returns ``items`` unchanged when overlap is disabled or there is no
+    store to account against."""
+    depth = settings.overlap_windows
+    if depth <= 0 or store is None:
+        return items
+    if size_of is None:
+        size_of = lambda b: b.nbytes()  # noqa: E731
+    from .ops import devtime
+
+    q = _queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    state = {"err": None, "done": False}
+    _END = object()
+
+    def produce():
+        try:
+            for item in items:
+                if stop.is_set():
+                    return
+                if item is None:
+                    # The serial consumer drops None windows (a
+                    # map_blocks mapper may emit them for empty input);
+                    # keep that contract rather than crash size_of.
+                    continue
+                nb = size_of(item) or 0
+                if nb:
+                    store.reserve_overlap(nb)
+                placed = False
+                while not stop.is_set():
+                    try:
+                        q.put((item, nb), timeout=0.05)
+                        placed = True
+                        break
+                    except _queue.Full:
+                        continue
+                if not placed:
+                    if nb:
+                        store.release_overlap(nb)
+                    return
+        except BaseException as e:  # delivered to the consumer
+            state["err"] = e
+        finally:
+            state["done"] = True
+            while not stop.is_set():
+                try:
+                    q.put((_END, 0), timeout=0.05)
+                    break
+                except _queue.Full:
+                    continue
+
+    thread = threading.Thread(target=produce, daemon=True,
+                              name="dampr-tpu-codec")
+
+    def gen():
+        thread.start()
+        devtime.slot_enter()
+        try:
+            while True:
+                # Stall accounting per poll slice: the slot counts as
+                # blocked-on-codec only while THIS job's producer thread
+                # is executing the native codec (devtime.active_in) —
+                # wait caused by producer-side IO/Python is real pipeline
+                # wait but is not codec-attributable (the ``codec``
+                # bucket doesn't count it either), and a sibling job's
+                # codec is not what this fold is blocked on.
+                while True:
+                    try:
+                        item, nb = q.get_nowait()
+                        break
+                    except _queue.Empty:
+                        pass
+                    stalled = devtime.active_in(thread.ident, "codec")
+                    if stalled:
+                        devtime.slot_stall()
+                    try:
+                        item, nb = q.get(timeout=0.05)
+                        got = True
+                    except _queue.Empty:
+                        got = False
+                    finally:
+                        if stalled:
+                            devtime.slot_unstall()
+                    if got:
+                        break
+                    if state["done"] and q.empty():
+                        item, nb = _END, 0
+                        break
+                if item is _END:
+                    if state["err"] is not None:
+                        raise state["err"]
+                    return
+                try:
+                    yield item
+                finally:
+                    if nb:
+                        store.release_overlap(nb)
+        finally:
+            devtime.slot_exit()
+            stop.set()
+
+            def drain():
+                while True:
+                    try:
+                        _item, nb = q.get_nowait()
+                    except _queue.Empty:
+                        return
+                    if nb:
+                        store.release_overlap(nb)
+
+            drain()
+            thread.join(timeout=5.0)
+            # The producer may have slipped one reserved block into the
+            # slot the first drain freed before it observed ``stop`` —
+            # with the thread joined, a second drain is conclusive.
+            drain()
+
+    return gen()
 
 
 class _SharedScanChunk(object):
@@ -186,12 +335,40 @@ class OutputDataset(Dataset):
             return None
         return blk.take(order)
 
+    def _merged_run_blocks(self):
+        """Stream a key-sorted run set (the spill-lean sort layout) through
+        the vectorized k-way merge: one in-flight window per run, every run
+        file read sequentially front to back, no read-side re-sort.  The
+        write-side merge planner already capped the fan-in, so the working
+        set is bounded."""
+        from .blocks import merge_sorted_streams
+
+        refs = [r for r in self.pset.all_refs() if len(r)]
+        if not refs:
+            return iter(())
+        return merge_sorted_streams([r.iter_windows() for r in refs])
+
+    def _key_sorted_blocks(self):
+        """Sorted-block iterator for a key-sorted run set.  Multi-device
+        meshes keep the collective range exchange — global order
+        parallelizes across devices; single-device (the gate declines)
+        streams the k-way merge."""
+        blocks = self._mesh_range_sorted(sorted(self.pset.parts))
+        if blocks is None:
+            blocks = self._merged_run_blocks()
+        return blocks
+
     def read(self):
         import itertools
 
         pids = sorted(self.pset.parts)
         if not pids:
             return iter(())
+        if getattr(self.pset, "key_sorted_runs", False):
+            # sorted_blocks() handles the whole strategy ladder (small
+            # concat, mesh range exchange, streamed k-way merge).
+            return itertools.chain.from_iterable(
+                b.iter_pairs() for b in self.sorted_blocks())
         if len(pids) == 1:
             return self._partition_stream(pids[0])
         blk = self._sorted_concat()
@@ -241,16 +418,38 @@ class OutputDataset(Dataset):
                 return None
             from .parallel import exchange as px
 
-            # Range bounds from a strided sample (hash-partitioned runs are
-            # key-random, so per-ref strides sample uniformly).
-            per = max(16, 65536 // len(refs))
+            # Range bounds from a strided sample.  Hash-partitioned runs
+            # are key-random, so ONE window per ref samples uniformly.
+            # Key-sorted runs are ordered WITHIN each run, so early
+            # windows hold only that run's smallest keys — but each run
+            # is one whole input chunk, so for unordered input every run
+            # spans the full key distribution and a FEW runs read end to
+            # end sample it faithfully; for pre-sorted input the runs
+            # cover disjoint ranges, which striding the run choice across
+            # the ref list covers.  Cost: <= 8 runs re-read (keys only),
+            # against a pass that re-reads everything anyway.
+            sorted_runs = bool(getattr(self.pset, "key_sorted_runs", False))
+            if sorted_runs:
+                # linspace, not a stride: the chosen runs must span BOTH
+                # ends of the ref list, or pre-sorted input (runs with
+                # disjoint ascending ranges) leaves the top of the key
+                # space unsampled and overloads the last bucket.
+                idx = np.unique(np.linspace(
+                    0, len(refs) - 1, min(8, len(refs))).astype(int))
+                sample_refs = [refs[i] for i in idx]
+                per = max(16, 65536 // max(1, sum(
+                    max(1, len(r) >> 14) for r in sample_refs)))
+            else:
+                sample_refs = refs
+                per = max(16, 65536 // len(refs))
             samples = []
-            for r in refs:
-                for w in r.iter_windows():
+            for r in sample_refs:
+                for wi, w in enumerate(r.iter_windows()):
                     if len(w):
                         stride = max(1, len(w) // per)
                         samples.append(np.asarray(w.keys[::stride]))
-                    break
+                    if not sorted_runs and wi == 0:
+                        break
             if not samples:
                 return iter(())
             allk = np.concatenate(samples)
@@ -412,6 +611,10 @@ class OutputDataset(Dataset):
             if len(blk):
                 yield blk
             return
+        if getattr(self.pset, "key_sorted_runs", False):
+            for b in self._key_sorted_blocks():
+                yield b
+            return
         pids = sorted(self.pset.parts)
         blocks = self._mesh_range_sorted(pids)
         if blocks is None:
@@ -544,27 +747,91 @@ class MTRunner(object):
                 chunks = [BlockDataset(refs)]
 
         (job, combine_op, pin, feeds_reduce, _new_sink,
-         feeds_dev) = self._map_job_factory(
+         feeds_dev, run_mode) = self._map_job_factory(
             stage, supplementary)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
         results = self._pool_run(job, chunks, n_maps)
         pset = self._collect_partitions(results, combine_op, pin,
-                                        feeds_reduce, device=feeds_dev)
+                                        feeds_reduce, device=feeds_dev,
+                                        sorted_runs=run_mode)
         return pset, pset.total_records(), len(chunks)
 
     def _collect_partitions(self, mappings, combine_op, pin, feeds_reduce,
-                            device=False):
+                            device=False, sorted_runs=False):
         """Assemble per-chunk {pid: [refs]} job results into one compacted
-        PartitionSet (shared by run_map and run_map_group)."""
-        pset = storage.PartitionSet(self.n_partitions)
+        PartitionSet (shared by run_map and run_map_group).
+
+        ``sorted_runs``: the jobs ran in spill-lean run mode — each mapping
+        carries a ``_sorted`` marker recording whether every one of its
+        blocks registered as a key-sorted run (numeric keys); the pset is
+        flagged ``key_sorted_runs`` only when ALL jobs' blocks did, so the
+        read-side streaming merge can trust every ref."""
+        all_sorted = bool(sorted_runs)
+        pset = storage.PartitionSet(
+            self.n_partitions,
+            hash_routed=not sorted_runs,
+            hash_sorted=not sorted_runs and (combine_op is not None
+                                             or feeds_reduce))
         for mapping in mappings:
+            if sorted_runs and not mapping.pop("_sorted", False):
+                all_sorted = False
             for pid, refs in mapping.items():
                 for ref in refs:
                     pset.add(pid, ref)
-        self._compact_partitions(pset, combine_op, pin, feeds_reduce,
-                                 device=device)
+        pset.key_sorted_runs = all_sorted
+        if all_sorted and pset.parts:
+            # Spill-lean path: no block-count compaction rewrite — merge
+            # planning caps the read fan-in instead, and under the cap the
+            # final read feeds straight from first-level runs.
+            self._plan_sorted_merge(pset)
+        else:
+            self._compact_partitions(pset, combine_op, pin, feeds_reduce,
+                                     device=device)
         return pset
+
+    def _effective_merge_fanin(self, runs):
+        """Fan-in cap for the sorted-run merge: the configured
+        ``settings.merge_fanin``, clamped so the k-way merge's working set
+        (one spill window per run, sized from the runs' observed
+        bytes/record) stays inside half the stage budget."""
+        total = sum(max(1, r.total_bytes) for r in runs)
+        nrec = sum(len(r) for r in runs)
+        window = max(1, int(total / max(1, nrec)) * storage.SPILL_WINDOW)
+        cap = max(4, int(self.store.budget // (2 * window)))
+        return max(2, min(settings.merge_fanin, cap))
+
+    def _plan_sorted_merge(self, pset):
+        """Merge planning for a key-sorted run set (the spill-lean external
+        sort).  When the number of first-level runs fits the fan-in cap,
+        nothing happens — the final read merges the runs directly, so the
+        only bytes that ever hit disk are the map jobs' single spill
+        generation.  Past the cap, runs merge in generations of ``fanin``
+        through a streamed file->file pass (one in-flight window per
+        source, output written as it merges — never RAM-resident whole)
+        until the count fits."""
+        from .blocks import merge_sorted_streams
+
+        runs = [r for r in pset.all_refs() if len(r)]
+        if not runs:
+            return
+        fanin = self._effective_merge_fanin(runs)
+        while len(runs) > fanin:
+            log.info("sorted-run merge generation: %d runs over fan-in %d",
+                     len(runs), fanin)
+            nxt = []
+            for at in range(0, len(runs), fanin):
+                group = runs[at:at + fanin]
+                if len(group) == 1:
+                    nxt.append(group[0])
+                    continue
+                merged = self.store.register_stream(merge_sorted_streams(
+                    [r.iter_windows() for r in group]))
+                for r in group:
+                    self.store.drop_ref(r)
+                nxt.append(merged)
+            runs = nxt
+        pset.parts = {0: runs}
 
     def _scan_share_group(self, sid, stage, env):
         """Later GMap stages reading the SAME tap source as `stage`: fusion
@@ -616,16 +883,25 @@ class MTRunner(object):
                     push, end = factories[i][4]()
                     members.append(
                         (_clone_op(s.mapper).window_sink(), push, end))
-                for win in _scan_windows(chunk):
-                    for wsink, push, _end in members:
-                        for blk in wsink.add(win) or ():
-                            push(blk)
-                outs = []
-                for wsink, push, end in members:
-                    for blk in wsink.finish() or ():
-                        push(blk)
-                    outs.append(end())
-                return outs
+
+                def codec():
+                    # ONE sequential window pass drives every member's
+                    # sink (sinks are stateful, so a single producer
+                    # thread owns them); the emitted (member, block)
+                    # pairs overlap with the fold/register consumer.
+                    for win in _scan_windows(chunk):
+                        for mi, (wsink, _push, _end) in enumerate(members):
+                            for blk in wsink.add(win) or ():
+                                yield mi, blk
+                    for mi, (wsink, _push, _end) in enumerate(members):
+                        for blk in wsink.finish() or ():
+                            yield mi, blk
+
+                for mi, blk in _overlap_stream(
+                        codec(), self.store,
+                        size_of=lambda it: it[1].nbytes()):
+                    members[mi][1](blk)
+                return [end() for _wsink, _push, end in members]
             shared = (_SharedScanChunk(chunk)
                       if hasattr(chunk, "read_bytes") else chunk)
             outs = [None] * len(stages)
@@ -641,10 +917,10 @@ class MTRunner(object):
         ret = []
         for i in range(len(stages)):
             (_job, combine_op, pin, feeds_reduce, _new_sink,
-             feeds_dev) = factories[i]
+             feeds_dev, run_mode) = factories[i]
             pset = self._collect_partitions(
                 [outs[i] for outs in results], combine_op, pin, feeds_reduce,
-                device=feeds_dev)
+                device=feeds_dev, sorted_runs=run_mode)
             ret.append((pset, pset.total_records(), len(chunks)))
         log.info("scan sharing: %d stages fused over one pass of %d chunks",
                  len(stages), len(chunks))
@@ -665,10 +941,11 @@ class MTRunner(object):
         # Hash-sorted runs are only needed when a reduce consumes this output
         # (it's what the over-budget streaming merge relies on); stages
         # feeding sinks or final reads skip the sort — their consumers
-        # re-order by key anyway.
-        feeds_reduce = any(
-            isinstance(s, GReduce) and stage.output in s.inputs
-            for s in self.graph.stages)
+        # re-order by key anyway.  The view is TRANSITIVE through identity
+        # checkpoints: a reduce behind ``checkpoint(force=True)`` still
+        # needs hash routing here, or the checkpoint's declined alias
+        # forces a full re-routing copy pass over the dataset.
+        feeds_reduce = self._reduce_consumes(stage.output)
         # HBM residency: outputs consumed by a device-foldable reduce keep
         # their numeric value lanes on device (storage register gates on
         # the lane whitelist + budget), so the map->reduce boundary never
@@ -685,6 +962,40 @@ class MTRunner(object):
                 and getattr(getattr(s.reducer, "op", None), "kind", None)
                 in ("sum", "min", "max")
                 for s in self.graph.stages))
+        # Spill-lean sorted-run mode (external sorts): outputs no reduce
+        # consumes don't need hash fan-out at all — their only readers
+        # re-order by key (OutputDataset) or stream refs whole (sinks,
+        # record maps).  Each job registers its chunk as ONE key-sorted run
+        # instead of `partitions` hash-routed sub-blocks; the compaction
+        # rewrite is replaced by fan-in-capped merge planning and the final
+        # read streams a k-way merge.  Jobs fall back to hash fan-out per
+        # chunk when keys aren't uniformly numeric (the ``_sorted`` marker
+        # records which happened).
+        sorted_run_mode = (settings.sort_runs_enabled()
+                           and combine_op is None
+                           and not feeds_reduce
+                           and not pin
+                           and not supplementary)
+
+        def try_sorted_run(blocks):
+            """Register one key-sorted run for this job, or None when the
+            keys don't qualify (caller falls back to hash fan-out)."""
+            blocks = [b for b in blocks if len(b)]
+            if not blocks:
+                return {"_sorted": True}
+            kdts = {b.keys.dtype for b in blocks}
+            if len(kdts) != 1 or next(iter(kdts)).kind not in "iuf":
+                return None
+            if (next(iter(kdts)).kind == "f"
+                    and any(np.isnan(b.keys).any() for b in blocks)):
+                # NaN has no total order: a NaN-tailed run would break
+                # the k-way merge's non-decreasing emission contract
+                # (NaN poisons the bound comparisons).  Hash fan-out
+                # handles NaN keys the same way it always has.
+                return None
+            merged = blocks[0] if len(blocks) == 1 else Block.concat(blocks)
+            merged = merged.take(np.argsort(merged.keys, kind="stable"))
+            return {0: [self.store.register(merged)], "_sorted": True}
 
         def new_sink():
             """Push-mode accumulator for one chunk job: push(blk) folds/
@@ -711,6 +1022,10 @@ class MTRunner(object):
                 if combine_op is not None and partials:
                     blocks = [segment.fold_block(
                         Block.concat(partials), combine_op)]
+                if sorted_run_mode:
+                    out = try_sorted_run(blocks)
+                    if out is not None:
+                        return out
                 # Register with the store *inside* the job so the memory
                 # budget is enforced while the stage runs, not after all
                 # jobs complete.  Every registered block is a hash-sorted
@@ -756,7 +1071,12 @@ class MTRunner(object):
                      and not use_blocks and not ident_blocks else None)
             push, end = new_sink()
             if use_blocks:
-                for blk in mapper.map_blocks(chunk):
+                # Stage-overlapped streaming executor: the codec (window
+                # scan + tokenize/parse inside map_blocks) runs ahead on
+                # its own thread while this thread folds/registers, with
+                # in-flight blocks charged against the run budget.
+                for blk in _overlap_stream(mapper.map_blocks(chunk),
+                                           self.store):
                     push(blk)
             elif ident_blocks:
                 for blk in chunk.iter_blocks():
@@ -831,7 +1151,7 @@ class MTRunner(object):
             return end()
 
         return (job, combine_op, pin, feeds_reduce, new_sink,
-                feeds_device_fold)
+                feeds_device_fold, sorted_run_mode)
 
     def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True,
                             device=False):
@@ -903,7 +1223,8 @@ class MTRunner(object):
         import jax
 
         if not refs:
-            return storage.PartitionSet(self.n_partitions), 0, 1
+            return storage.PartitionSet(self.n_partitions, hash_routed=True,
+                                        hash_sorted=True), 0, 1
         # Cheap metadata check before touching any (possibly spilled) data.
         if any(getattr(r, "value_dtype", object) == object for r in refs):
             return None
@@ -1161,7 +1482,9 @@ class MTRunner(object):
                 log.info("mesh fold: %d HBM-resident blocks consumed "
                          "on-device", dev_folds)
             if not partials:
-                return storage.PartitionSet(self.n_partitions), 0, 1
+                return storage.PartitionSet(self.n_partitions,
+                                            hash_routed=True,
+                                            hash_sorted=True), 0, 1
             if len(partials) > 1:
                 compact()
         except _HostPath:
@@ -1275,7 +1598,8 @@ class MTRunner(object):
         P = self.n_partitions
         pin = bool(stage.options.get("memory"))
         if not refs:
-            return storage.PartitionSet(P), 0, 1
+            return storage.PartitionSet(P, hash_routed=True,
+                                        hash_sorted=True), 0, 1
         # The one-pass fold materializes every ref at once, so it must stay
         # inside the streaming memory discipline, not just the tiny-stage
         # cutoff.
@@ -1288,7 +1612,8 @@ class MTRunner(object):
             return None
         merged = Block.concat([r.get() for r in refs])
         if not len(merged):
-            return storage.PartitionSet(P), 0, 1
+            return storage.PartitionSet(P, hash_routed=True,
+                                        hash_sorted=True), 0, 1
         folded = segment.fold_sorted(
             segment.sort_and_group(merged), stage.reducer.op)
         h1, h2 = folded.hashes()
@@ -1312,7 +1637,11 @@ class MTRunner(object):
         for i in range(n):
             vcol[i] = (kl[i], vl[i])
         out_blk = Block(keys, vcol, h1, h2)
-        pset = storage.PartitionSet(P)
+        # Hash-routed by construction (split below); the sub-blocks keep the
+        # fold's output order, which is NOT a (h1, h2)-sorted run — consumers
+        # that need sorted runs (a following reduce) re-establish them in the
+        # copy stage the alias gate forces.
+        pset = storage.PartitionSet(P, hash_routed=True)
         nrec = 0
         for pid, sub in out_blk.split_by_partition(P).items():
             nrec += len(sub)
@@ -1525,6 +1854,47 @@ class MTRunner(object):
         nrec = sum(n for _, n in results)
         return _SinkOutput(paths), nrec, len(chunks)
 
+    def _reduce_consumes(self, output, _seen=None):
+        """Does a GReduce consume ``output`` — directly, or through
+        identity checkpoint stages (which alias or copy it forward
+        unchanged)?  Run-mode planning (sorted runs vs hash fan-out) and
+        the alias provenance gate share this transitive view so they
+        cannot disagree about what a downstream reduce will need."""
+        seen = _seen if _seen is not None else set()
+        if output in seen:
+            return False
+        seen.add(output)
+        for s in self.graph.stages:
+            if output not in s.inputs:
+                continue
+            if isinstance(s, GReduce):
+                return True
+            if (isinstance(s, GMap)
+                    and type(s.mapper) is base.Map
+                    and s.mapper.mapper is base._identity
+                    and s.combiner is None
+                    and "binop" not in s.options
+                    and self._reduce_consumes(s.output, seen)):
+                return True
+        return False
+
+    def _alias_provenance_ok(self, stage, src):
+        """May an identity checkpoint alias ``src`` instead of running the
+        copy stage?  The copy stage it elides would hash-route every record
+        (split_by_partition) and register hash-sorted runs — invariants a
+        consuming GReduce depends on for partition-local grouping and the
+        over-budget streaming merge.  So the alias stands only when no
+        reduce consumes the output (directly or through further identity
+        checkpoints), or the input already carries both invariants by
+        construction (map-stage outputs).  Reduce outputs are registered
+        under the reduce job's pid with whatever keys the reducer emitted
+        — e.g. ``X.partition_reduce(f).partition_reduce(g)`` aliasing f's
+        output would leave g grouping each key only within f's job
+        partitions: silently wrong results (ADVICE round 5)."""
+        if not self._reduce_consumes(stage.output):
+            return True
+        return src.hash_routed and src.hash_sorted
+
     # -- main walk ---------------------------------------------------------
     def run(self, outputs, cleanup=True):
         if settings.profile_dir:
@@ -1629,7 +1999,9 @@ class MTRunner(object):
                         and isinstance(env[stage.inputs[0]],
                                        storage.PartitionSet)
                         and env[stage.inputs[0]].n_partitions
-                        == self.n_partitions):
+                        == self.n_partitions
+                        and self._alias_provenance_ok(stage,
+                                                      env[stage.inputs[0]])):
                     # Identity checkpoint over an already-materialized
                     # partition set: alias it instead of re-registering
                     # (and re-spilling) every byte through a copy stage.

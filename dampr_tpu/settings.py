@@ -219,6 +219,43 @@ mesh_exchange = os.environ.get("DAMPR_TPU_MESH_EXCHANGE", "auto")
 #: chunk computes.  0 disables.  See inputs.Readahead.
 readahead_chunks = int(os.environ.get("DAMPR_TPU_READAHEAD", "2"))
 
+#: Codec->fold overlap depth (the stage-overlapped streaming executor):
+#: each map job runs its codec — decompress + tokenize/parse, the
+#: ``map_blocks`` window scan — on a dedicated thread that stays this many
+#: produced blocks ahead of the fold/register consumer, extending the raw-
+#: byte readahead (``readahead_chunks``) up through the codec.  In-flight
+#: codec output is charged byte-for-byte against the stage memory budget
+#: (storage.RunStore.reserve_overlap), so overlapping displaces resident
+#: blocks instead of raising the memory ceiling.  0 = serial (codec and
+#: fold interleave on the job thread, the pre-round-6 behavior).
+overlap_windows = int(os.environ.get("DAMPR_TPU_OVERLAP_WINDOWS", "2"))
+
+#: Spill-lean sorted-run mode for map outputs no reduce ever consumes
+#: (external sorts: ``ParseNumbers -> checkpoint``): each map job registers
+#: its chunk's output as ONE key-sorted run instead of hash-fanning it into
+#: ``partitions`` sub-blocks, the block-count compaction rewrite is skipped,
+#: and the final read streams a k-way merge over the runs.  "auto"/"on"
+#: enable it (jobs fall back to hash fan-out per chunk when keys are
+#: non-numeric); "off" restores hash fan-out everywhere.  Reduce-consumed
+#: outputs are never eligible — they keep hash routing, and the identity-
+#: checkpoint alias gate forces a re-routing copy stage if a sorted-run
+#: set ever flows toward a reduce.
+sort_runs = os.environ.get("DAMPR_TPU_SORT_RUNS", "auto")
+
+#: Maximum first-level sorted runs the final read merges directly.  At or
+#: under this fan-in the output streams straight from first-level runs —
+#: zero re-spill generations, each run file read once, sequentially.  Past
+#: it, runs merge in generations of ``merge_fanin`` through a streamed
+#: file->file pass (storage.register_stream) until the count fits.  The
+#: effective cap also respects the memory budget: a merge holds one spill
+#: window per run, so the planner clamps fan-in to
+#: ``budget // per-run-window-bytes`` (floor 4).
+merge_fanin = int(os.environ.get("DAMPR_TPU_MERGE_FANIN", "512"))
+
+
+def sort_runs_enabled():
+    return str(sort_runs).lower() not in ("off", "0", "false")
+
 #: Spill compression policy: "auto" (default) gzips object-lane blocks and
 #: writes fully-numeric blocks plain (high-entropy lanes don't compress and
 #: the gzip pass is core-bound both ways); "always"/"never" force it.

@@ -160,11 +160,16 @@ class BlockRef(object):
         blk = self.get()  # one counted device fetch of the value lane
         freed = self.dev_bytes
         old_host = self.nbytes
+        # Publish order matters: reduce jobs read this ref concurrently
+        # (eviction runs outside the store lock), so the host block must be
+        # visible BEFORE the device lanes disappear — mirroring spill(),
+        # which writes ``path`` before clearing ``_block``.  A reader that
+        # still sees ``_dev`` uses its own snapshot (get() below).
+        self._block = blk
+        self.nbytes = blk.nbytes()
         self._dev = None
         self._kmeta = None
         self.dev_bytes = 0
-        self._block = blk
-        self.nbytes = blk.nbytes()
         return freed, self.nbytes - old_host
 
     @classmethod
@@ -206,21 +211,29 @@ class BlockRef(object):
     def get(self):
         blk = self._block
         if blk is None:
-            if self._dev is not None:
+            # Snapshot the device lanes + host metadata into locals: a
+            # concurrent offload() publishes _block first, then clears
+            # _dev/_kmeta, so a reader passing the _dev check must not
+            # re-read those slots (it could otherwise unpack a None).
+            dev, kmeta = self._dev, self._kmeta
+            if dev is not None and kmeta is not None:
                 # Host materialization of a device-resident block: one
                 # value-lane fetch (counted — the HBM tier's whole point is
                 # that device-fold reduces never take this path).
                 from .ops import devtime
 
                 with devtime.track("transfer"):
-                    vals = np.asarray(self._dev[0]).astype(
+                    vals = np.asarray(dev[0]).astype(
                         self.value_dtype, copy=False)
                 if self.store is not None:
                     self.store.count_d2h(vals.nbytes)
-                keys, h1, h2 = self._kmeta
+                keys, h1, h2 = kmeta
                 from .blocks import Block
 
                 return Block(keys, vals, h1, h2)
+            blk = self._block  # re-check: offload may have just published
+            if blk is not None:
+                return blk
             if self._packed is not None:
                 return unpack_block(self._packed)
             blk = load_block(self.path)
@@ -233,14 +246,17 @@ class BlockRef(object):
         whole (resident blocks yield array-view slices)."""
         blk = self._block
         if blk is None:
-            if self._dev is not None:
+            if self._packed is not None:
+                blk = unpack_block(self._packed)
+            elif self._dev is not None or self.path is None:
+                # Device-resident — or an offload racing us (path exists
+                # only once spilled): get() resolves the live tier with a
+                # consistent snapshot.
                 blk = self.get()
-            elif self._packed is None:
+            else:
                 for w in iter_block_windows(self.path):
                     yield w
                 return
-            else:
-                blk = unpack_block(self._packed)
         from .blocks import Block
 
         n = len(blk)
@@ -280,32 +296,44 @@ class BlockRef(object):
 SPILL_WINDOW = 16384
 
 
+def _spill_plain(key_dtype, value_dtype):
+    """Compression policy, shared by every spill writer: numeric columns
+    (hashes, parsed numbers, counts) are mostly high-entropy, so gzip buys
+    little and costs a core-bound pass each way — they spill uncompressed
+    at disk bandwidth; object lanes compress.  ``settings.spill_compress``
+    = "always"/"never" overrides the heuristic."""
+    mode = str(settings.spill_compress).lower()
+    numeric = key_dtype != object and value_dtype != object
+    return mode == "never" or (mode not in ("always", "1", "true")
+                               and numeric)
+
+
+def _dump_windows(block, f, at_least_one=False):
+    """Write one block onto an open spill stream as pickled columnar
+    SPILL_WINDOW slices — THE wire format ``iter_block_windows`` reads."""
+    n = len(block)
+    for at in range(0, max(n, 1) if at_least_one else n, SPILL_WINDOW):
+        end = min(at + SPILL_WINDOW, n)
+        pickle.dump(
+            (block.keys[at:end], block.values[at:end],
+             None if block.h1 is None else block.h1[at:end],
+             None if block.h2 is None else block.h2[at:end]),
+            f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
 def save_block(block, path):
     """Spill wire format: a sequence of pickled columnar windows, inside one
     gzip stream for object-lane blocks or as a plain stream for fully
-    numeric ones.  Windowing keeps spilled blocks *streamable* — merge
+    numeric ones (``_spill_plain``; readers sniff the gzip magic, so both
+    formats coexist).  Windowing keeps spilled blocks *streamable* — merge
     readers hold one window per run — while numeric lanes serialize as raw
-    buffers (pickle protocol 5).  Numeric columns (hashes, parsed numbers,
-    counts) are mostly high-entropy, so gzip buys little and costs a
-    core-bound pass each way — they spill uncompressed at disk bandwidth
-    (``settings.spill_compress`` = "always"/"never" overrides the
-    heuristic); readers sniff the gzip magic, so both formats coexist."""
-    n = len(block)
-    mode = str(settings.spill_compress).lower()
-    numeric = (block.keys.dtype != object and block.values.dtype != object)
-    plain = mode == "never" or (mode not in ("always", "1", "true")
-                                and numeric)
+    buffers (pickle protocol 5)."""
+    plain = _spill_plain(block.keys.dtype, block.values.dtype)
     opener = (lambda: open(path, "wb")) if plain else (
         lambda: gzip.open(path, "wb",
                           compresslevel=settings.compress_level))
     with opener() as f:
-        for at in range(0, max(n, 1), SPILL_WINDOW):
-            end = min(at + SPILL_WINDOW, n)
-            pickle.dump(
-                (block.keys[at:end], block.values[at:end],
-                 None if block.h1 is None else block.h1[at:end],
-                 None if block.h2 is None else block.h2[at:end]),
-                f, protocol=pickle.HIGHEST_PROTOCOL)
+        _dump_windows(block, f, at_least_one=True)
 
 
 def iter_block_windows(path):
@@ -382,10 +410,42 @@ class RunStore(object):
         self.d2h_bytes = 0
         self.hbm_offloads = 0
         self.hbm_peak_bytes = 0
+        # Overlap executor accounting: bytes of in-flight scan windows /
+        # codec output the pipelined map driver holds ahead of the fold.
+        # Charged against the same budget as resident blocks (reserving
+        # overlap bytes pushes resident refs out to disk), so overlapping
+        # never raises the stage's memory ceiling.
+        self._overlap_bytes = 0
+        self.overlap_peak_bytes = 0
+        # Spill-lean merge generations: bytes written by streamed run
+        # compactions (register_stream) — the only re-spill generation the
+        # merge planner ever pays, and only past the merge_fanin cap.
+        self.merge_gen_bytes = 0
+        self.merge_gens = 0
 
     def count_d2h(self, n):
         with self._lock:
             self.d2h_bytes += n
+
+    # -- overlap (pipelined map driver) accounting --------------------------
+    @property
+    def overlap_bytes(self):
+        return self._overlap_bytes
+
+    def reserve_overlap(self, n):
+        """Charge ``n`` in-flight overlap bytes against the budget; resident
+        refs spill to make room, so codec readahead trades RAM residency
+        instead of adding to it."""
+        with self._lock:
+            self._overlap_bytes += n
+            self.overlap_peak_bytes = max(self.overlap_peak_bytes,
+                                          self._overlap_bytes)
+            victims, evicted_dev = self._select_victims_locked()
+        self._spill_victims(victims, evicted_dev)
+
+    def release_overlap(self, n):
+        with self._lock:
+            self._overlap_bytes = max(0, self._overlap_bytes - n)
 
     def hbm_budget(self):
         return settings.effective_hbm_budget()
@@ -443,6 +503,76 @@ class RunStore(object):
         for v in dev_victims:
             self._offload_ref(v)
         self._spill_victims(victims, evicted_dev)
+        return ref
+
+    def register_stream(self, blocks):
+        """Materialize an iterator of key-sorted window blocks straight into
+        a disk-backed ref: the spill-lean merge generation.  Data streams
+        file -> merge -> file in SPILL_WINDOW units and is never RAM- or
+        budget-resident as a whole; the returned ref reads back through the
+        normal spilled-block path (iter_windows is sequential IO).
+
+        The compression heuristic matches save_block: decided from the
+        first window's dtypes (a merged run is dtype-uniform by
+        construction — its sources were windows of one logical column
+        pair)."""
+        directory = os.path.join(self.root, self._stage)
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, uuid.uuid4().hex + ".blk")
+        raw = f = None
+        total_records = 0
+        total_bytes = 0
+        key_dtype = value_dtype = np.dtype(object)
+        try:
+            for blk in blocks:
+                if not len(blk):
+                    continue
+                if f is None:
+                    key_dtype = blk.keys.dtype
+                    value_dtype = blk.values.dtype
+                    raw = open(path, "wb")
+                    f = raw if _spill_plain(key_dtype, value_dtype) else \
+                        gzip.GzipFile(fileobj=raw, mode="wb",
+                                      compresslevel=settings.compress_level)
+                _dump_windows(blk, f)
+                total_records += len(blk)
+                total_bytes += blk.nbytes()
+        except BaseException:
+            # A failed generation (disk full, merge-source read error)
+            # must not leak the fd or strand a partial .blk no ref owns.
+            for h in (f, raw):
+                if h is not None:
+                    try:
+                        h.close()
+                    except OSError:
+                        pass
+            if raw is not None:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            raise
+        else:
+            if f is not None:
+                f.close()
+                if f is not raw:
+                    raw.close()
+        ref = BlockRef.from_disk(path if f is not None else None,
+                                 total_records, total_bytes,
+                                 key_dtype, value_dtype)
+        ref.store = self
+        if f is None:
+            # empty stream: nothing on disk, an empty resident block
+            from .blocks import Block
+
+            ref.path = None
+            ref._block = Block.empty()
+        stack = getattr(self._attempts, "stack", None)
+        if stack:
+            stack[-1].append(ref)
+        with self._lock:
+            self.merge_gens += 1
+            self.merge_gen_bytes += total_bytes
         return ref
 
     def _select_dev_victims_locked(self):
@@ -507,14 +637,20 @@ class RunStore(object):
         budget as already relieved.  Returns (spill_victims, evicted_dev):
         HBM-resident refs' host metadata (keys+hashes) is not spillable in
         place, so under host pressure those refs are evicted whole —
-        offload + disk — and leave both accountings here."""
-        if self._resident_bytes <= self.budget:
+        offload + disk — and leave both accountings here.
+
+        In-flight overlap bytes shrink the effective residency target: the
+        pipelined map driver's windows are charged against the same budget,
+        so readahead displaces resident blocks instead of stacking on
+        top of them."""
+        target = max(0, self.budget - self._overlap_bytes)
+        if self._resident_bytes <= target:
             return [], []
         victims = []
         evicted_dev = []
         keep = []
         for ref in self._resident:
-            if self._resident_bytes <= self.budget or ref.pin:
+            if self._resident_bytes <= target or ref.pin:
                 keep.append(ref)
             elif ref.resident:
                 victims.append(ref)
@@ -571,13 +707,36 @@ class RunStore(object):
 class PartitionSet(object):
     """The stage-exchange format: {partition_id: [BlockRef]} — the engine
     analog of the reference's {partition: [Dataset]} dicts
-    (base.py:416-433, runner.py:163-172)."""
+    (base.py:416-433, runner.py:163-172).
 
-    __slots__ = ("parts", "n_partitions")
+    Provenance flags (how these refs were produced — what downstream fast
+    paths may assume):
 
-    def __init__(self, n_partitions):
+    - ``hash_routed``: every record lives in partition ``h1 % P`` (map
+      outputs routed through ``split_by_partition``).  Reduce outputs are
+      registered under the reduce *job's* pid without re-hashing whatever
+      keys the reducer emitted, so they are NOT hash-routed.
+    - ``hash_sorted``: every ref is a (h1, h2)-sorted run — the invariant
+      the over-budget streaming merge (StreamingGroupedView) relies on.
+    - ``key_sorted_runs``: every ref is a KEY-sorted run (ascending,
+      numeric keys) registered without partition fan-out — the spill-lean
+      merge plan for outputs no reduce ever consumes; the final read
+      streams a k-way merge over the runs instead of re-sorting.
+
+    The identity-checkpoint alias (runner) is gated on these: an alias may
+    stand in for the elided copy stage only when the input already carries
+    the invariants that stage would have established."""
+
+    __slots__ = ("parts", "n_partitions", "hash_routed", "hash_sorted",
+                 "key_sorted_runs")
+
+    def __init__(self, n_partitions, hash_routed=False, hash_sorted=False,
+                 key_sorted_runs=False):
         self.parts = {}
         self.n_partitions = n_partitions
+        self.hash_routed = hash_routed
+        self.hash_sorted = hash_sorted
+        self.key_sorted_runs = key_sorted_runs
 
     def add(self, pid, ref):
         self.parts.setdefault(pid, []).append(ref)

@@ -36,6 +36,32 @@ _settings.device_min_batch = 4096
 
 import pytest  # noqa: E402
 
+#: The reference repo's README, used by several kernels tests as a natural-
+#: text corpus.  Containers without the reference mounted get a
+#: deterministic synthetic stand-in with the same character classes the
+#: real file exercises (mixed case, punctuation, digits, underscores,
+#: blank lines, a little UTF-8).
+_REFERENCE_README = "/root/reference/README.md"
+
+
+def reference_text():
+    try:
+        with open(_REFERENCE_README) as f:
+            return f.read()
+    except OSError:
+        words = ["Dampr", "map", "reduce", "Stream_Fold", "chunk42",
+                 "naïve", "pipeline", "DAG", "a", "the", "of", "tokens",
+                 "spill", "merge", "TPU", "block", "codec", "fold"]
+        lines = []
+        for i in range(120):
+            row = [words[(i * 7 + j * 3) % len(words)]
+                   for j in range(3 + i % 9)]
+            sep = ", " if i % 4 else " -- "
+            lines.append(sep.join(row) + (".", "!", "", ":")[i % 4])
+            if i % 17 == 0:
+                lines.append("")
+        return "\n".join(lines) + "\n"
+
 
 @pytest.fixture(scope="session")
 def mesh8():

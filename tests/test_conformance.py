@@ -20,6 +20,8 @@ from dampr_tpu import (BlockMapper, BlockReducer, Dampr, Dataset, Map, Reduce,
 from dampr_tpu import settings
 from dampr_tpu.utils import filter_by_count
 
+from conftest import reference_text
+
 
 @pytest.fixture(autouse=True)
 def small_partitions(partitions8):
@@ -376,7 +378,7 @@ class TestInputs:
     def test_text_wordcount_matches_counter(self, tmp_path):
         import collections
         p = str(tmp_path / "corpus.txt")
-        text = (open("/root/reference/README.md").read()) * 3
+        text = (reference_text()) * 3
         with open(p, "w") as f:
             f.write(text)
         got = dict(Dampr.text(p, chunk_size=4096)

@@ -15,6 +15,8 @@ from dampr_tpu.ops import hashing
 from dampr_tpu.parallel import mesh_global_sum, mesh_keyed_fold
 from dampr_tpu.parallel.mesh import mesh_size
 
+from conftest import reference_text
+
 
 def _fold_to_dict(keyspace, fh1, fh2, fv):
     kh1, kh2 = hashing.hash_keys(np.asarray(keyspace))
@@ -98,7 +100,7 @@ class TestMeshKeyedFold:
         assert got == {1: 5000, 2: 5000}
 
     def test_string_keys_wordcount(self, mesh8):
-        words = (open("/root/reference/README.md").read() * 5).split()
+        words = (reference_text() * 5).split()
         h1, h2 = hashing.hash_keys(words)
         fh1, fh2, fv = mesh_keyed_fold(
             mesh8, h1, h2, np.ones(len(words), dtype=np.int64), "sum")

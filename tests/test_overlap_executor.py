@@ -1,0 +1,384 @@
+"""Round-6 tentpole coverage: the stage-overlapped codec->fold executor
+(exactness, bounded in-flight memory, kill-mid-window retry) and the
+spill-lean sorted-run merge planning for external sorts."""
+
+import operator
+import os
+import re
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.ops.text import DocFreq, ParseNumbers
+from dampr_tpu.runner import MTRunner, _overlap_stream
+
+
+@pytest.fixture(autouse=True)
+def _settings_guard():
+    saved = (settings.partitions, settings.max_memory_per_stage,
+             settings.overlap_windows, settings.sort_runs,
+             settings.merge_fanin, settings.job_retries)
+    settings.partitions = 8
+    yield
+    (settings.partitions, settings.max_memory_per_stage,
+     settings.overlap_windows, settings.sort_runs,
+     settings.merge_fanin, settings.job_retries) = saved
+
+
+def _write_numbers(tmp_path, n, seed=11):
+    rng = np.random.RandomState(seed)
+    ks = rng.randint(0, 1 << 48, size=n)
+    path = str(tmp_path / "nums.txt")
+    with open(path, "w") as f:
+        f.write("\n".join(str(k) for k in ks) + "\n")
+    return path, ks
+
+
+def _write_corpus(tmp_path, lines, seed=4):
+    words = ["alpha", "beta", "Gamma", "delta", "tok7", "x9", "the"]
+    rng = np.random.RandomState(seed)
+    path = str(tmp_path / "corpus.txt")
+    with open(path, "w") as f:
+        for _ in range(lines):
+            f.write(" ".join(words[j]
+                             for j in rng.randint(0, len(words), 9)) + "\n")
+    return path
+
+
+def _doc_freq_truth(path):
+    rx = re.compile(r"[^\w]+")
+    want = Counter()
+    with open(path) as f:
+        for line in f:
+            want.update(t for t in set(rx.split(line.lower())) if t)
+    return dict(want)
+
+
+def _run_doc_freq(path, chunk_size=1 << 17):
+    docs = Dampr.text(path, chunk_size)
+    df = (docs.custom_mapper(DocFreq(mode="word", lower=True,
+                                     pair_values=False))
+          .fold_values(operator.add))
+    runner = MTRunner("overlap-tfidf", df.pmer.graph)
+    out = runner.run([df.source])
+    got = {k: v[1] for k, v in out[0].read()}
+    out[0].delete()
+    return got, runner
+
+
+def _run_sort(path, chunk_size=1 << 19):
+    pipe = (Dampr.text(path, chunk_size)
+            .custom_mapper(ParseNumbers())
+            .checkpoint(force=True))
+    runner = MTRunner("overlap-sort", pipe.pmer.graph)
+    out = runner.run([pipe.source])
+    return out[0], runner
+
+
+class TestOverlapExactness:
+    def test_tfidf_overlap_matches_serial(self, tmp_path):
+        path = _write_corpus(tmp_path, 12000)
+        want = _doc_freq_truth(path)
+        results = {}
+        for depth in (0, 3):
+            settings.overlap_windows = depth
+            got, runner = _run_doc_freq(path)
+            assert runner.store.overlap_bytes == 0
+            results[depth] = got
+            runner.store.cleanup()
+        assert results[0] == want
+        assert results[3] == results[0]
+
+    def test_sort_overlap_matches_serial(self, tmp_path):
+        path, ks = _write_numbers(tmp_path, 150000)
+        want = sorted(ks.tolist())
+        settings.max_memory_per_stage = 1 << 20  # force spilled runs
+        for depth in (0, 2):
+            settings.overlap_windows = depth
+            out, runner = _run_sort(path)
+            got = [k for k, _v in out.read()]
+            assert got == want, "depth={}".format(depth)
+            assert runner.store.overlap_bytes == 0
+            out.delete()
+            runner.store.cleanup()
+
+
+class TestOverlapMemory:
+    def test_reserve_displaces_resident_blocks(self, tmp_path):
+        # The governor invariant: in-flight overlap bytes shrink the
+        # residency target, so reserving pushes resident refs to disk
+        # instead of raising the ceiling.
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.storage import RunStore
+
+        store = RunStore("overlap-governor", budget=1 << 20)
+        arr = np.arange(40000, dtype=np.int64)
+        refs = [store.register(Block(arr.copy(), arr.copy()))]
+        assert refs[0].resident
+        store.reserve_overlap(1 << 20)  # whole budget in-flight
+        assert not refs[0].resident, "resident ref not displaced"
+        assert refs[0].path is not None
+        assert store.spill_count >= 1
+        store.release_overlap(1 << 20)
+        assert store.overlap_bytes == 0
+        assert store.overlap_peak_bytes == 1 << 20
+        store.cleanup()
+
+    def test_in_flight_bytes_bounded_by_depth(self, tmp_path):
+        # Track the overlap high-water mark during a real run: it must be
+        # bounded by (depth + 2) windows' worth per concurrent job — queue
+        # slots plus the producer's in-hand block plus the one being
+        # folded — never the whole codec output.
+        path, ks = _write_numbers(tmp_path, 200000)
+        depth = 2
+        settings.overlap_windows = depth
+        out, runner = _run_sort(path, chunk_size=1 << 18)
+        total_out = sum(r.total_bytes for r in out.pset.all_refs())
+        peak = runner.store.overlap_peak_bytes
+        assert peak > 0, "overlap executor never engaged"
+        # per-chunk codec output is ~chunk_size * 1.7 (two int64 lanes for
+        # ~11-byte text records); bound with slack for worker concurrency
+        per_block = int((1 << 18) * 2)
+        assert peak <= (depth + 2) * settings.max_processes * per_block
+        assert peak < total_out or total_out <= (depth + 2) * per_block
+        assert runner.store.overlap_bytes == 0
+        out.delete()
+        runner.store.cleanup()
+
+
+class _FlakyParse(ParseNumbers):
+    """ParseNumbers whose codec dies mid-stream on its first invocation:
+    the first window block comes out, then the scan raises — simulating a
+    killed window inside an overlapped job."""
+
+    attempts = []  # class-level: survives the per-job _clone_op deepcopy
+
+    def window_sink(self):
+        inner = ParseNumbers.window_sink(self)
+
+        class _Sink(object):
+            def add(_s, win):
+                blocks = inner.add(win)
+                if not _FlakyParse.attempts:
+                    _FlakyParse.attempts.append(1)
+                    raise IOError("synthetic codec failure mid-window")
+                return blocks
+
+            def finish(_s):
+                return inner.finish()
+
+        return _Sink()
+
+
+class TestOverlapRetry:
+    def test_kill_mid_window_retries_without_leaks(self, tmp_path):
+        path, ks = _write_numbers(tmp_path, 60000)
+        settings.overlap_windows = 2
+        settings.job_retries = 1
+        _FlakyParse.attempts = []
+        pipe = (Dampr.text(path, chunk_size=1 << 18)
+                .custom_mapper(_FlakyParse())
+                .checkpoint(force=True))
+        runner = MTRunner("overlap-retry", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        assert _FlakyParse.attempts, "failure never injected"
+        got = [k for k, _v in out[0].read()]
+        assert got == sorted(ks.tolist())
+        # the killed window's reservations and refs were rolled back
+        assert runner.store.overlap_bytes == 0
+        out[0].delete()
+        runner.store.cleanup()
+
+    def test_consumer_abandonment_drains_reservations(self):
+        # Unit-level: a consumer that stops mid-stream (exception in the
+        # fold) must stop the producer and drain every reservation.
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.storage import RunStore
+
+        store = RunStore("overlap-drain", budget=1 << 22)
+        settings.overlap_windows = 2
+
+        def codec():
+            for i in range(50):
+                arr = np.arange(1000, dtype=np.int64)
+                yield Block(arr, arr.copy())
+
+        with pytest.raises(RuntimeError):
+            for i, blk in enumerate(_overlap_stream(codec(), store)):
+                if i == 3:
+                    raise RuntimeError("fold died")
+        assert store.overlap_bytes == 0
+        store.cleanup()
+
+
+class TestSortedRunPlanning:
+    def test_direct_feed_under_fanin(self, tmp_path):
+        # Fan-in fits: zero merge generations — the read feeds straight
+        # from first-level runs and nothing is re-spilled.
+        path, ks = _write_numbers(tmp_path, 120000)
+        settings.max_memory_per_stage = 64 * 1024 * 1024
+        out, runner = _run_sort(path, chunk_size=1 << 18)
+        assert out.pset.key_sorted_runs
+        assert runner.store.merge_gens == 0
+        assert [k for k, _v in out.read()] == sorted(ks.tolist())
+        out.delete()
+        runner.store.cleanup()
+
+    def test_merge_generations_past_fanin(self, tmp_path):
+        path, ks = _write_numbers(tmp_path, 150000)
+        settings.merge_fanin = 2
+        out, runner = _run_sort(path, chunk_size=1 << 17)
+        assert out.pset.key_sorted_runs
+        assert runner.store.merge_gens >= 1
+        assert len(out.pset.parts.get(0, [])) <= 2
+        assert [k for k, _v in out.read()] == sorted(ks.tolist())
+        out.delete()
+        runner.store.cleanup()
+
+    def test_object_keys_fall_back_to_hash_fanout(self):
+        # String keys can't register as numeric sorted runs: jobs fall
+        # back to hash fan-out and the pset must not claim the invariant.
+        # (sort_by rekeys each record by the sort key, so string values
+        # become object-dtype keys.)
+        items = ["b", "a", "c", "aa", "z"] * 50
+        pipe = Dampr.memory(items).sort_by(lambda v: v)
+        runner = MTRunner("runs-fallback", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        assert not out[0].pset.key_sorted_runs
+        got = [v for _k, v in out[0].read()]
+        assert got == sorted(items)
+        out[0].delete()
+        runner.store.cleanup()
+
+    def test_nan_keys_fall_back_to_hash_fanout(self):
+        # NaN float keys have no total order: a NaN-tailed run would
+        # poison the k-way merge's bound comparisons, so jobs decline
+        # sorted-run registration and take the hash fan-out path.
+        items = [3.5, float("nan"), 1.25, 2.0, float("nan"), 0.5] * 40
+        pipe = Dampr.memory(items).sort_by(lambda v: v)
+        runner = MTRunner("runs-nan", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        assert not out[0].pset.key_sorted_runs
+        got = [v for _k, v in out[0].read()]
+        assert len(got) == len(items)
+        finite = [v for v in got if v == v]
+        assert finite == sorted(v for v in items if v == v)
+        assert sum(1 for v in got if v != v) == sum(
+            1 for v in items if v != v)
+        out[0].delete()
+        runner.store.cleanup()
+
+    def test_checkpoint_then_reduce_regroups(self, tmp_path):
+        # A reduce downstream of a forced checkpoint: run-mode planning
+        # sees the reduce THROUGH the identity checkpoint, so the map
+        # keeps hash fan-out (no sorted runs), the checkpoint aliases
+        # instead of paying a re-routing copy pass, and grouping is
+        # global and exact.
+        path, ks = _write_numbers(tmp_path, 5000, seed=3)
+        small = [int(k) % 97 for k in ks]
+        spath = str(tmp_path / "small.txt")
+        with open(spath, "w") as f:
+            f.write("\n".join(str(v) for v in small) + "\n")
+
+        def keyed_sum(groups):
+            for k, vs in groups:
+                yield k, sum(v[1] if isinstance(v, tuple) else v
+                             for v in vs)
+
+        pipe = (Dampr.text(spath, chunk_size=1 << 14)
+                .custom_mapper(ParseNumbers())
+                .checkpoint(force=True)
+                .partition_reduce(keyed_sum))
+        runner = MTRunner("runs-reduce", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        # StreamReducer records read back as (k, (k, v)): unwrap the value
+        got = {k: v[1] for k, v in out[0].read()}
+        want = {}
+        for v in small:
+            want[v] = want.get(v, 0) + v
+        assert got == want
+        # The efficient plan: the checkpoint aliased the hash-routed map
+        # output — no full re-routing copy stage ran.
+        assert any(st.kind == "map-alias" for st in runner.stats)
+        out[0].delete()
+        runner.store.cleanup()
+
+
+class TestMergeTieBuffering:
+    """merge_sorted_streams tie handling: extension windows append
+    straight to the output (no re-concat), and a giant tie group stops
+    extending once the round's extension budget is spent, so
+    low-cardinality runs never go whole-RAM-resident."""
+
+    @staticmethod
+    def _windows(keys, vals, width):
+        from dampr_tpu.blocks import Block
+
+        return [Block(keys[a:a + width], vals[a:a + width], None, None)
+                for a in range(0, len(keys), width)]
+
+    def test_low_cardinality_merge_stays_bounded(self):
+        from dampr_tpu.blocks import merge_sorted_streams
+
+        old = settings.max_memory_per_stage
+        settings.max_memory_per_stage = 1 << 20  # ext budget floor: 1 MB
+        try:
+            n = 150_000  # per stream; one key spans ~2.4 MB per stream
+            streams, want = [], []
+            for s in range(2):
+                ks = np.full(n, 7, dtype=np.int64)
+                vs = np.arange(n, dtype=np.int64) + s * n
+                want.append(vs)
+                streams.append(self._windows(ks, vs, 16384))
+            out = list(merge_sorted_streams(streams))
+            total = sum(len(b) for b in out)
+            assert total == 2 * n
+            assert all((np.diff(b.keys) >= 0).all() for b in out)
+            # The giant tie group must straddle rounds instead of
+            # buffering both runs whole: no emitted block may hold
+            # everything.
+            assert max(len(b) for b in out) < 2 * n
+            got = np.sort(np.concatenate([np.asarray(b.values)
+                                          for b in out]))
+            assert np.array_equal(got, np.sort(np.concatenate(want)))
+        finally:
+            settings.max_memory_per_stage = old
+
+    def test_tie_heavy_merge_exact_and_ordered(self):
+        from dampr_tpu.blocks import merge_sorted_streams
+
+        rng = np.random.RandomState(11)
+        streams, allk, allv = [], [], []
+        for s in range(4):
+            ks = np.sort(rng.randint(0, 10, size=5000).astype(np.int64))
+            vs = rng.randint(0, 1 << 30, size=5000).astype(np.int64)
+            allk.append(ks)
+            allv.append(vs)
+            streams.append(self._windows(ks, vs, 257))
+        out = list(merge_sorted_streams(streams))
+        keys = np.concatenate([np.asarray(b.keys) for b in out])
+        assert (np.diff(keys) >= 0).all()
+        assert np.array_equal(np.sort(keys), np.sort(np.concatenate(allk)))
+        got = sorted(zip(keys.tolist(),
+                         np.concatenate([np.asarray(b.values)
+                                         for b in out]).tolist()))
+        want = sorted(zip(np.concatenate(allk).tolist(),
+                          np.concatenate(allv).tolist()))
+        assert got == want
+
+
+@pytest.mark.slow
+class TestOverlap128MBTier:
+    def test_tfidf_exactness_at_tier(self, tmp_path):
+        from dampr_tpu.bench_tfidf import make_corpus
+
+        corpus = str(tmp_path / "corpus_128mb.txt")
+        make_corpus(corpus, 128)
+        want = _doc_freq_truth(corpus)
+        settings.overlap_windows = 2
+        got, runner = _run_doc_freq(corpus, chunk_size=1 << 26)
+        assert got == want
+        assert runner.store.overlap_bytes == 0
+        runner.store.cleanup()

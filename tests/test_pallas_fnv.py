@@ -6,10 +6,12 @@ import numpy as np
 from dampr_tpu.ops import hashing
 from dampr_tpu.ops.pallas_fnv import fnv_pallas
 
+from conftest import reference_text
+
 
 class TestPallasFNV:
     def test_matches_numpy_on_words(self):
-        words = (open("/root/reference/README.md").read() * 3).split()
+        words = (reference_text() * 3).split()
         mat, lens = hashing.encode_str_keys(words)
         w1, w2 = hashing._fnv_numpy(mat, lens)
         p1, p2 = fnv_pallas(mat, lens, interpret=True)

@@ -1,0 +1,187 @@
+"""Round-6 advisor regressions: identity-checkpoint alias provenance,
+BlockRef.offload publish order, mixed-dtype composite-lane concat."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+
+
+@pytest.fixture(autouse=True)
+def small_partitions():
+    old = settings.partitions
+    settings.partitions = 8
+    yield
+    settings.partitions = old
+
+
+def _unwrap(v):
+    # StreamReducer output records are (k, (k, v)); group values of a
+    # SECOND partition_reduce therefore arrive as (k, v) tuples.
+    return v[1] if isinstance(v, tuple) else v
+
+
+def _keyed_sum(groups):
+    for k, vs in groups:
+        yield k, sum(_unwrap(v) for v in vs)
+
+
+def _rekey_mod3(groups):
+    for k, vs in groups:
+        yield k % 3, sum(_unwrap(v) for v in vs)
+
+
+class TestAliasProvenance:
+    def test_partition_reduce_chain_regroups(self):
+        # ADVICE round 5 (high): the identity checkpoint between two
+        # partition_reduce stages must re-route by hash — the first
+        # reducer's output keys are arbitrary and registered under the
+        # reduce job's pid, so aliasing it leaves the second reduce
+        # grouping each key only within the first's partitions.
+        items = list(range(1000))  # keys = positions, values = i
+        emitter = (Dampr.memory(items)
+                   .partition_reduce(_rekey_mod3)
+                   .partition_reduce(_keyed_sum)
+                   .run(name="alias-regroup"))
+        vals = emitter.read()
+        assert len(vals) == 3, (
+            "partition_reduce chain regrouped per-partition: "
+            "{} records".format(len(vals)))
+        got = dict(vals)
+        want = {r: sum(i for i in range(1000) if i % 3 == r)
+                for r in range(3)}
+        assert got == want
+        emitter.delete()
+
+    def test_map_checkpoint_still_aliases(self):
+        # The benign case keeps the fast path: a forced identity
+        # checkpoint over a map output no reduce consumes.
+        from dampr_tpu.runner import MTRunner
+
+        items = [i * 2 for i in range(100)]
+        pipe = Dampr.memory(items).map(lambda v: v + 1).checkpoint(
+            force=True).checkpoint(force=True)
+        runner = MTRunner("alias-ok", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        assert sorted(v for _k, v in out[0].read()) == sorted(
+            v * 2 + 1 for v in range(100))
+        assert any(st.kind == "map-alias" for st in runner.stats)
+        out[0].delete()
+
+    def test_reduce_output_flags_not_routed(self):
+        from dampr_tpu.runner import MTRunner
+
+        items = list(range(50))
+        pipe = (Dampr.memory(items).partition_reduce(_keyed_sum))
+        runner = MTRunner("flags", pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        assert not out[0].pset.hash_routed or out[0].pset.hash_sorted is False
+        out[0].delete()
+
+
+class TestOffloadPublishOrder:
+    def test_offload_publishes_block_before_clearing_dev(self, monkeypatch):
+        # Readers race eviction: after offload() the host block must be
+        # visible the moment the device lanes are gone.  Drive offload
+        # step-by-step by observing the ref from a second thread at every
+        # attribute write.
+        jax = pytest.importorskip("jax")
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.storage import BlockRef
+
+        vals = np.arange(8192, dtype=np.int64)
+        blk = Block(vals.copy(), vals.copy())
+        prep = BlockRef.lane_prep(blk.values)
+        assert prep is not None
+        ref = BlockRef(blk, store=None, device_prep=prep)
+        assert ref.is_device
+
+        seen = []
+        orig_setattr = BlockRef.__setattr__
+
+        def spying_setattr(self, name, value):
+            orig_setattr(self, name, value)
+            if name in ("_block", "_dev", "_kmeta"):
+                # every intermediate state must be readable
+                got = self.get()
+                seen.append((name, len(got)))
+
+        monkeypatch.setattr(BlockRef, "__setattr__", spying_setattr)
+        ref.offload()
+        monkeypatch.setattr(BlockRef, "__setattr__", orig_setattr)
+        assert seen, "offload never published"
+        assert all(n == len(vals) for _attr, n in seen)
+        got = ref.get()
+        assert np.array_equal(np.asarray(got.values), vals)
+
+    def test_concurrent_get_during_offload_loop(self):
+        # Hammer get() from a reader thread while offloading device refs;
+        # any publish-order bug shows up as load_block(None) / NoneType
+        # unpacking.  (Before the fix this raised within a few hundred
+        # iterations.)
+        jax = pytest.importorskip("jax")
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.storage import BlockRef
+
+        vals = np.arange(4096, dtype=np.int64)
+        errors = []
+        for _ in range(50):
+            blk = Block(vals.copy(), vals.copy())
+            prep = BlockRef.lane_prep(blk.values)
+            ref = BlockRef(blk, store=None, device_prep=prep)
+            stop = threading.Event()
+
+            def reader():
+                try:
+                    while not stop.is_set():
+                        got = ref.get()
+                        assert len(got) == len(vals)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            t = threading.Thread(target=reader)
+            t.start()
+            ref.offload()
+            stop.set()
+            t.join()
+            assert not errors, errors[0]
+
+
+class TestCompositeLaneConcat:
+    def _roundtrip(self, blocks):
+        from dampr_tpu.blocks import Block, pylist
+
+        merged = Block.concat(blocks)
+        return pylist(merged.values)
+
+    def test_int_then_float_tuples_keep_types(self):
+        from dampr_tpu.blocks import Block
+
+        a = Block.from_pairs([("a", (1, 2)), ("b", (3, 4))])
+        b = Block.from_pairs([("c", (1.5, 2.5))])
+        assert a.values.dtype == np.int64 and a.values.ndim == 2
+        assert b.values.dtype == np.float64 and b.values.ndim == 2
+        vals = self._roundtrip([a, b])
+        assert vals == [(1, 2), (3, 4), (1.5, 2.5)]
+        assert [type(x) for t in vals for x in t] == [
+            int, int, int, int, float, float]
+
+    def test_float_then_int_tuples_keep_types(self):
+        from dampr_tpu.blocks import Block
+
+        a = Block.from_pairs([("a", (1.5, 2.5))])
+        b = Block.from_pairs([("b", (1, 2))])
+        vals = self._roundtrip([a, b])
+        assert vals == [(1.5, 2.5), (1, 2)]
+        assert [type(x) for t in vals for x in t] == [
+            float, float, int, int]
+
+    def test_same_dtype_composites_stay_vectorized(self):
+        from dampr_tpu.blocks import Block
+
+        a = Block.from_pairs([("a", (1, 2))])
+        b = Block.from_pairs([("b", (3, 4))])
+        merged = Block.concat([a, b])
+        assert merged.values.dtype == np.int64 and merged.values.ndim == 2

@@ -11,6 +11,8 @@ import pytest
 from dampr_tpu import Dampr, native, settings
 from dampr_tpu.ops import text as T
 
+from conftest import reference_text
+
 SAMPLE = (
     "The quick brown fox jumps over the lazy dog\n"
     "the quick BROWN fox, the dog!\n"
@@ -51,7 +53,7 @@ class TestChunkKernels:
         assert got == dict(py_doc_freq(SAMPLE))
 
     def test_native_and_numpy_agree(self):
-        data = open("/root/reference/README.md", "rb").read() * 7
+        data = reference_text().encode("utf-8") * 7
         import dampr_tpu.native as nat
         blk_native = T.chunk_doc_freq(data)
         old = nat._lib, nat._tried
@@ -130,7 +132,7 @@ class TestChunkKernels:
 class TestDSLIntegration:
     def test_token_counts_pipeline_multi_chunk(self, tmp_path):
         p = str(tmp_path / "c.txt")
-        data = (open("/root/reference/README.md").read()) * 9
+        data = reference_text() * 9
         with open(p, "w") as f:
             f.write(data)
         got = dict(
@@ -142,7 +144,7 @@ class TestDSLIntegration:
 
     def test_doc_freq_pipeline_multi_chunk(self, tmp_path):
         p = str(tmp_path / "d.txt")
-        data = (open("/root/reference/README.md").read()) * 9
+        data = reference_text() * 9
         with open(p, "w") as f:
             f.write(data)
         got = dict(
@@ -292,7 +294,7 @@ class TestNativeParse:
 class TestFoldValues:
     def test_fold_values_matches_fold_by(self, tmp_path):
         p = str(tmp_path / "c.txt")
-        data = (open("/root/reference/README.md").read()) * 9
+        data = reference_text() * 9
         open(p, "w").write(data)
         fast = dict(
             Dampr.text(p, chunk_size=8192)
