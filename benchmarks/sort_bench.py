@@ -105,6 +105,13 @@ def main():
         "merge_generations": runner.store.merge_gens,
         "merge_gen_mb": round(runner.store.merge_gen_bytes / 1e6, 1),
         "sorted_runs": bool(out[0].pset.key_sorted_runs),
+        # Cross-check for the per-run summary (dampr_tpu.obs): the
+        # per-stage spill-bytes sum must track the store's measured spill
+        # volume (they are boundary snapshots of the same counter).
+        "stage_spill_mb": round(sum(
+            s["spill_bytes"] for s in runner.run_summary["stages"]) / 1e6,
+            1) if runner.run_summary else None,
+        "trace_file": (runner.run_summary or {}).get("trace_file"),
     }))
 
 

@@ -17,8 +17,8 @@ import logging
 from .base import (BlockMapper, BlockReducer, Map, Mapper, Reduce, Reducer,
                    StreamMapper, StreamReducer, Streamable)
 from .blocks import Block, BlockBuilder
-from .dampr import (ARReduce, Dampr, PBase, PJoin, PMap, PReduce, ValueEmitter,
-                    setup_logging)
+from .dampr import (ARReduce, Dampr, PBase, PJoin, PMap, PReduce, RunStats,
+                    ValueEmitter, setup_logging)
 from .dataset import (BlockDataset, CatDataset, Chunker, Dataset, EmptyDataset,
                       GzipLineDataset, MemoryDataset, StreamDataset,
                       TextLineDataset)
@@ -30,6 +30,7 @@ __version__ = "0.2.0"
 
 __all__ = [
     "Dampr", "PBase", "PMap", "PReduce", "PJoin", "ARReduce", "ValueEmitter",
+    "RunStats",
     "Mapper", "Streamable", "Map", "BlockMapper", "StreamMapper",
     "Reducer", "Reduce", "BlockReducer", "StreamReducer",
     "Graph", "Source", "MTRunner",

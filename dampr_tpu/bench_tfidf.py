@@ -144,9 +144,9 @@ def run_dampr_tpu(corpus, outdir):
         lambda df, total: (df[0], df[1],
                            math.log(1 + (float(total) / df[1]))),
         memory=True)
-    idf.sink_tsv(outdir).run(name="bench-tfidf")
+    em = idf.sink_tsv(outdir).run(name="bench-tfidf")
     secs = time.time() - t0
-    return secs
+    return secs, em.stats()
 
 
 def check_result(outdir, counter, total):
@@ -178,22 +178,46 @@ def main():
     log("baseline (1 core): {:.2f}s = {:.1f} MB/s".format(
         base_secs, size_mb / base_secs))
 
+    from dampr_tpu import settings as _trace_settings
+
+    # Every bench run under one name would overwrite one trace dir, so the
+    # reported artifact paths could belong to a different trial than the
+    # reported (winning) numbers; give each run its own directory instead.
+    old_trace_dir = _trace_settings.trace_dir
+    if _trace_settings.trace:
+        _trace_settings.trace_dir = os.path.join(BENCH_DIR, "traces", "cold")
     ours_dir = os.path.join(BENCH_DIR, "dampr-idf")
-    cold = run_dampr_tpu(corpus, ours_dir)
+    cold, _cold_summary = run_dampr_tpu(corpus, ours_dir)
     log("dampr_tpu cold: {:.2f}s".format(cold))
     # warm steady-state: best of two runs (this box time-shares one core
     # with unrelated tenants; a single sample is noise-prone), with the
     # wall-time split (device kernels / transfers / native codec) taken
-    # from the winning run
+    # from the winning run.  Epoch/delta snapshots (not reset()) keep the
+    # accounting run-scoped: another in-flight run's counters are never
+    # clobbered by this bench.
     from dampr_tpu.ops import devtime
 
     best = None
-    for _ in range(2):
-        devtime.reset()
-        t = run_dampr_tpu(corpus, ours_dir)
+    for trial in range(2):
+        if _trace_settings.trace:
+            _trace_settings.trace_dir = os.path.join(
+                BENCH_DIR, "traces", "trial-{}".format(trial))
+        epoch = devtime.epoch()
+        t, summary = run_dampr_tpu(corpus, ours_dir)
+        split = devtime.delta(epoch)
+        trial_line = ("trial {}: {:.2f}s  spill {:.1f} MB  "
+                      "merge-gens {}".format(
+                          trial, t,
+                          summary.get("store", {}).get("spilled_bytes",
+                                                       0) / 1e6,
+                          summary.get("store", {}).get("merge_gens", 0)))
+        if summary.get("trace_file"):
+            trial_line += "  trace {}".format(summary["trace_file"])
+        log(trial_line)
         if best is None or t < best[0]:
-            best = (t, devtime.snapshot())
-    secs, split = best
+            best = (t, split, summary)
+    _trace_settings.trace_dir = old_trace_dir
+    secs, split, summary = best
     log("dampr_tpu warm: {:.2f}s = {:.1f} MB/s".format(secs, size_mb / secs))
     # Non-overlapped codec seconds: the codec time still on the critical
     # path.  With the overlap executor off every codec second blocks the
@@ -236,6 +260,15 @@ def main():
         # the codec burned, overlapped or not.
         "codec_nonoverlapped_fraction": round(codec_nonov / secs, 4),
         "overlap_windows": _settings.overlap_windows,
+        # Run-scoped observability (winning warm run): spill/merge volume
+        # from the per-run summary, plus artifact locations when tracing
+        # was on (DAMPR_TPU_TRACE=1) — stats.json carries per-stage
+        # records/bytes/spill and the trace loads in Perfetto.
+        "spilled_mb": round(summary.get("store", {}).get(
+            "spilled_bytes", 0) / 1e6, 1),
+        "merge_generations": summary.get("store", {}).get("merge_gens", 0),
+        "trace_file": summary.get("trace_file"),
+        "stats_file": summary.get("stats_file"),
     }))
 
 

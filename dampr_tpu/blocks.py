@@ -340,6 +340,7 @@ def merge_sorted_streams(streams):
     never exceeds the per-round budget plus one window per stream.
     """
     from . import settings
+    from .obs import trace as _trace
 
     its = [iter(s) for s in streams]
     n = len(its)
@@ -371,6 +372,7 @@ def merge_sorted_streams(streams):
         for i in range(n):
             load(i)
         while True:
+            _t0 = _trace.now()
             bound = None
             for i in range(n):
                 if buf[i] is not None and (bound is None or last[i] < bound):
@@ -424,6 +426,11 @@ def merge_sorted_streams(streams):
                         break
             merged = Block.concat(pieces)
             if len(merged):
+                # One span per merge round (each round drains at least a
+                # full window, so these are chunky, not per-record); the
+                # interval covers gather+sort, not the consumer's time.
+                _trace.complete("merge", "k-way-round", _t0,
+                                records=len(merged), streams=n)
                 yield merged.take(np.argsort(merged.keys, kind="stable"))
 
     return gen()

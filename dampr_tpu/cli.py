@@ -6,14 +6,19 @@ canonical workloads run from an installed package without a repo checkout.
 
 - ``dampr-tpu-bench``  — the TF-IDF headline benchmark (same code path the
   repo-root ``bench.py`` driver hook runs; DAMPR_BENCH_MB sizes the corpus).
-- ``dampr-tpu-wc``     — word count over a file/dir, top-20 to stdout.
-- ``dampr-tpu-tfidf``  — TF-IDF over a file/dir, TSV parts to --out.
+- ``dampr-tpu-wc``     — word count over a file/dir, top-20 to stdout
+  (``--stats`` appends the run summary).
+- ``dampr-tpu-tfidf``  — TF-IDF over a file/dir, TSV parts to --out
+  (``--stats`` appends the run summary).
+- ``dampr-tpu-stats``  — pretty-print a completed run's ``stats.json``
+  and locate its Perfetto-loadable trace (see ``settings.trace``).
 """
 
 import argparse
 import math
 import operator
 import os
+import sys
 
 
 def bench():
@@ -21,10 +26,19 @@ def bench():
     main()
 
 
+def _print_stats(emitter):
+    from .obs import export
+
+    print()
+    print(export.format_summary(emitter.stats()))
+
+
 def wc():
     ap = argparse.ArgumentParser(description="word count (top 20)")
     ap.add_argument("path")
     ap.add_argument("--chunk-mb", type=int, default=16)
+    ap.add_argument("--stats", action="store_true",
+                    help="print the run's stage/spill/devtime summary")
     args = ap.parse_args()
 
     from . import Dampr
@@ -36,6 +50,8 @@ def wc():
     for word, count in sorted(counts, key=lambda kv: kv[1],
                               reverse=True)[:20]:
         print("{}: {}".format(word, count))
+    if args.stats:
+        _print_stats(counts)
     counts.delete()
 
 
@@ -43,6 +59,8 @@ def tf_idf():
     ap = argparse.ArgumentParser(description="TF-IDF -> TSV parts")
     ap.add_argument("path")
     ap.add_argument("--out", default="/tmp/dampr_tpu_idfs")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the run's stage/spill/devtime summary")
     args = ap.parse_args()
 
     from . import Dampr
@@ -57,5 +75,37 @@ def tf_idf():
         docs.len(),
         lambda d, total: (d[0], d[1], math.log(1 + float(total) / d[1])),
         memory=True)
-    idf.sink_tsv(args.out).run("tfidf-cli")
+    em = idf.sink_tsv(args.out).run("tfidf-cli")
     print("TSV parts in {}".format(args.out))
+    if args.stats:
+        _print_stats(em)
+
+
+def stats():
+    """Locate and pretty-print a run's persisted stats.json (written when
+    ``settings.trace`` / DAMPR_TPU_TRACE=1 was on for the run)."""
+    ap = argparse.ArgumentParser(
+        description="pretty-print a run's stats.json + trace location")
+    ap.add_argument("run", help="run name (as passed to run(name=...)), a "
+                                "run scratch directory, or a stats.json "
+                                "path")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw stats.json instead of formatting")
+    args = ap.parse_args()
+
+    from .obs import export
+
+    summary, path = export.load_stats(args.run)
+    if summary is None:
+        print("no stats.json found for {!r} (searched under {}); traced "
+              "runs write one — enable settings.trace / DAMPR_TPU_TRACE=1"
+              .format(args.run, export.run_trace_dir(args.run)),
+              file=sys.stderr)
+        raise SystemExit(2)
+    if args.json:
+        import json
+
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print("stats: {}".format(path))
+        print(export.format_summary(summary))

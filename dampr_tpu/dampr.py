@@ -38,14 +38,41 @@ from .ops import segment
 from .runner import MTRunner
 
 
+class RunStats(list):
+    """Per-run metrics handle: a list of per-stage dicts (the historical
+    ``ValueEmitter.stats`` shape, kept for compatibility) that is also
+    *callable* — ``emitter.stats()`` returns the full run summary dict
+    (the ``stats.json`` payload: stages, devtime, spill/merge/mesh totals,
+    overlap stall fraction, retry counts, trace file location).  See
+    :mod:`dampr_tpu.obs`."""
+
+    def __init__(self, stages=(), summary=None):
+        super(RunStats, self).__init__(stages)
+        self.summary = summary if summary is not None else {}
+
+    def __call__(self):
+        return self.summary
+
+    @property
+    def trace_file(self):
+        """Path of the run's Chrome trace-event JSON (None untraced)."""
+        return self.summary.get("trace_file")
+
+    @property
+    def stats_file(self):
+        """Path of the persisted stats.json (None untraced)."""
+        return self.summary.get("stats_file")
+
+
 class ValueEmitter(object):
     """Reads values from a completed run — the shell-friendly result handle
     (reference dampr.py:19-51).  ``stats`` holds the run's per-stage metrics
-    (jobs, records, seconds) — observability the reference lacks."""
+    (jobs, records, seconds) and, called as ``stats()``, the full run
+    summary — observability the reference lacks."""
 
     def __init__(self, dataset):
         self.dataset = dataset
-        self.stats = []
+        self.stats = RunStats()
 
     def stream(self):
         for _k, v in self.dataset.read():
@@ -102,7 +129,9 @@ class PBase(object):
         runner = self.pmer.runner(name, self.pmer.graph, **kwargs)
         ds = runner.run([self.source])
         em = ValueEmitter(ds[0])
-        em.stats = [s.as_dict() for s in getattr(runner, "stats", [])]
+        em.stats = RunStats(
+            [s.as_dict() for s in getattr(runner, "stats", [])],
+            getattr(runner, "run_summary", None))
         return em
 
     def read(self, k=None, **kwargs):
@@ -615,7 +644,8 @@ class Dampr(object):
         name = kwargs.pop("name", "dampr/{}".format(random.random()))
         runner = pmer.pmer.runner(name, graph, **kwargs)
         ds = runner.run(sources)
-        stats = [s.as_dict() for s in getattr(runner, "stats", [])]
+        stats = RunStats([s.as_dict() for s in getattr(runner, "stats", [])],
+                         getattr(runner, "run_summary", None))
         emitters = []
         for d in ds:
             em = ValueEmitter(d)

@@ -1,0 +1,70 @@
+"""Observability: run-scoped trace spans + per-run metrics.
+
+Enable with ``settings.trace = True`` (env ``DAMPR_TPU_TRACE=1``).  Off
+— the default — every instrumentation site costs one module-global
+``None`` check, so the engine's hot loops are unaffected.  On, each run
+records spans at the hot engine boundaries and persists two artifacts
+under ``<scratch_root>/<run>/trace/`` (``settings.trace_dir`` overrides
+the root):
+
+**trace.json — the timeline.**  Chrome trace-event JSON (the JSON Array
+Format with a ``traceEvents`` envelope).  Load it in Perfetto: open
+https://ui.perfetto.dev and drag the file in (chrome://tracing works
+too).  Lanes (``tid`` + ``thread_name`` metadata) map to the engine's
+concurrency units: one track per map slot (pool worker), per overlapped
+codec producer thread, per reduce worker, per merge generation.  Span
+categories (event ``cat``):
+
+- ``codec`` — one span per produced codec window (decompress + tokenize/
+  parse) on the producer thread's lane;
+- ``fold`` — map-side partial/final segment folds;
+- ``stall`` — a fold consumer blocked on its producer (the per-slot view
+  of devtime's ``codec_wait`` union);
+- ``spill`` / ``hbm`` — budget-pressure block spills; HBM h2d puts and
+  device->host offloads;
+- ``merge`` — spill-lean merge generations, streamed merge runs, k-way
+  read rounds, compaction markers;
+- ``collective`` — mesh keyed folds, byte exchanges, global sums;
+- ``checkpoint`` — resume persist/restore/plan/gc decisions;
+- ``job`` / ``stage`` — per-job spans on worker lanes; one span per
+  stage on the ``stages`` lane;
+- ``retry`` — instant markers for re-executed jobs.
+
+The emitted subset is documented (and CI-validated) by
+``docs/trace_schema.json`` + ``tools/validate_trace.py``.
+
+**stats.json — the summary** (schema ``dampr-tpu-stats/1``), also
+returned in-memory from every run — traced or not — via
+``ValueEmitter.stats()``:
+
+- ``stages[]`` — per stage: ``kind``, ``jobs``, ``records_in/out``,
+  ``bytes_in/out``, ``spill_count``/``spill_bytes`` (causal attribution:
+  charged to the stage whose pressure evicted the block),
+  ``merge_gens``/``merge_gen_bytes``, ``retries``, ``seconds``;
+- ``devtime`` — run-scoped device/transfer/codec/codec_wait seconds
+  (epoch/delta snapshots of :mod:`dampr_tpu.ops.devtime`);
+- ``overlap`` — configured windows, ``stall_fraction`` (codec_wait /
+  wall: the codec time still on the critical path), peak in-flight bytes;
+- ``store`` — spill/merge/HBM-tier totals; ``mesh`` — collective fold/
+  exchange counts and bytes; ``retries``; ``totals``;
+- ``trace_file`` / ``stats_file`` — artifact paths (None untraced).
+
+Surfacing: ``dampr-tpu-stats <run>`` pretty-prints a persisted summary;
+``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` accept ``--stats``; the TF-IDF
+bench emits per-trial spill/trace info and the artifact paths in its
+JSON line.
+
+For a profiler-grade XLA kernel timeline (HLO names, TPU counters) use
+the existing escape hatch instead: ``settings.profile_dir`` wraps the
+run in ``jax.profiler.trace`` for TensorBoard/xprof.
+
+Layering: :mod:`.trace` is the recorder (``Tracer``, module-level
+``span``/``instant``/``complete``/``timed_iter``); :mod:`.export`
+serializes (``write_trace``, ``write_stats``, ``load_stats``,
+``format_summary``).  ``MTRunner.run`` owns the lifecycle: it starts the
+tracer, builds the summary either way, and persists both files for
+traced runs.
+"""
+
+from .trace import Tracer, complete, enabled, instant, now, span  # noqa: F401
+from . import export  # noqa: F401

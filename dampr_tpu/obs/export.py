@@ -1,0 +1,187 @@
+"""Serialize a run's observability artifacts.
+
+Two files, written side by side under the run's trace directory
+(``<scratch_root>/<run>/trace/`` by default, ``settings.trace_dir``
+overrides the root):
+
+- ``trace.json`` — Chrome trace-event format (the JSON Array Format with a
+  ``traceEvents`` envelope), loadable in Perfetto (ui.perfetto.dev) or
+  chrome://tracing.  Span categories map to event ``cat``; lanes map to
+  ``tid`` with ``thread_name`` metadata, so each map slot / codec producer
+  / reduce worker / merge generation renders as its own track.
+- ``stats.json`` — the per-run summary (schema ``dampr-tpu-stats/1``):
+  per-stage records/bytes in+out, spill volume, merge generations, retry
+  counts, run-scoped devtime buckets, overlap stall fraction, store/mesh
+  totals, and span aggregates.
+
+The checked-in ``docs/trace_schema.json`` documents (and CI validates) the
+trace-event subset this module emits.
+"""
+
+import json
+import os
+import time
+
+from .. import settings
+
+STATS_SCHEMA = "dampr-tpu-stats/1"
+TRACE_FILE = "trace.json"
+STATS_FILE = "stats.json"
+
+
+def run_trace_dir(run_name):
+    """Where a run's artifacts live.  Mirrors RunStore's scratch layout so
+    the trace sits next to the run's durable spill/checkpoint outputs."""
+    safe = run_name.replace("/", "_")
+    root = settings.trace_dir or settings.scratch_root
+    return os.path.join(root, safe, "trace")
+
+
+def chrome_events(tracer):
+    """Convert a Tracer's compact event tuples into Chrome trace events."""
+    pid = 1
+    out = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": "dampr_tpu:{}".format(tracer.run)}}]
+    # Stable small tids: Perfetto sorts tracks by tid, so number lanes in
+    # first-seen order instead of leaking giant thread idents.
+    tid_of = {}
+    for lane, lname in tracer.lane_names.items():
+        tid = tid_of.setdefault(lane, len(tid_of) + 1)
+        out.append({"ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_name", "args": {"name": lname}})
+    for cat, name, t0, dur, lane, args in tracer.events:
+        tid = tid_of.setdefault(lane, len(tid_of) + 1)
+        ev = {"name": name, "cat": cat, "pid": pid, "tid": tid,
+              "ts": round(t0 * 1e6, 3)}
+        if dur is None:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = round(dur * 1e6, 3)
+        if args:
+            ev["args"] = args
+        out.append(ev)
+    return out
+
+
+def write_trace(tracer, path):
+    doc = {
+        "traceEvents": chrome_events(tracer),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "run": tracer.run,
+            "wall_start": tracer.wall_start,
+            "producer": "dampr_tpu.obs",
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def write_stats(summary, path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def locate_stats(run):
+    """Resolve a run name / run directory / stats.json path to the stats
+    file.  Returns the path or None."""
+    cands = []
+    if os.path.isfile(run):
+        cands.append(run)
+    if os.path.isdir(run):
+        cands.append(os.path.join(run, STATS_FILE))
+        cands.append(os.path.join(run, "trace", STATS_FILE))
+    cands.append(os.path.join(run_trace_dir(run), STATS_FILE))
+    for c in cands:
+        if os.path.isfile(c):
+            return c
+    return None
+
+
+def load_stats(run):
+    """(summary dict, path) for a run name/dir/file, or (None, None)."""
+    path = locate_stats(run)
+    if path is None:
+        return None, None
+    with open(path) as f:
+        return json.load(f), path
+
+
+def _mb(n):
+    return "{:.1f} MB".format(n / 1e6)
+
+
+def format_summary(summary):
+    """Human-readable rendering of a stats.json summary (the
+    ``dampr-tpu-stats`` CLI and the workload ``--stats`` flags)."""
+    lines = []
+    add = lines.append
+    add("run: {}  ({:.2f}s wall, {} stages)".format(
+        summary.get("run", "?"), summary.get("wall_seconds", 0.0),
+        len(summary.get("stages", []))))
+    started = summary.get("started_at")
+    if started:
+        add("started: {}".format(
+            time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(started))))
+    add("")
+    add("{:>5} {:<12} {:>5} {:>12} {:>12} {:>10} {:>10} {:>10} {:>8}".format(
+        "stage", "kind", "jobs", "rec_in", "rec_out", "bytes_in",
+        "bytes_out", "spill", "secs"))
+    for st in summary.get("stages", []):
+        add("{:>5} {:<12} {:>5} {:>12} {:>12} {:>10} {:>10} {:>10} {:>8}"
+            .format(st.get("stage", "?"), st.get("kind", "?"),
+                    st.get("jobs", 0), st.get("records_in", 0),
+                    st.get("records_out", 0), _mb(st.get("bytes_in", 0)),
+                    _mb(st.get("bytes_out", 0)),
+                    _mb(st.get("spill_bytes", 0)),
+                    "{:.2f}".format(st.get("seconds", 0.0))))
+    store = summary.get("store", {})
+    add("")
+    add("spill: {} blocks / {}  ·  merge generations: {} ({})".format(
+        store.get("spill_count", 0), _mb(store.get("spilled_bytes", 0)),
+        store.get("merge_gens", 0), _mb(store.get("merge_gen_bytes", 0))))
+    if store.get("h2d_bytes") or store.get("hbm_offloads"):
+        add("HBM tier: {} up, {} fetched back, {} offloads, peak {}".format(
+            _mb(store.get("h2d_bytes", 0)), _mb(store.get("d2h_bytes", 0)),
+            store.get("hbm_offloads", 0), _mb(store.get("hbm_peak_bytes",
+                                                        0))))
+    mesh = summary.get("mesh", {})
+    if mesh.get("folds") or mesh.get("exchanges"):
+        add("mesh: {} collective folds, {} exchanges ({} moved)".format(
+            mesh.get("folds", 0), mesh.get("exchanges", 0),
+            _mb(mesh.get("exchange_bytes", 0))))
+    dev = summary.get("devtime", {})
+    if dev:
+        add("devtime: device {:.2f}s · transfer {:.2f}s · codec {:.2f}s "
+            "(non-overlapped {:.2f}s)".format(
+                dev.get("device", 0.0), dev.get("transfer", 0.0),
+                dev.get("codec", 0.0), dev.get("codec_wait", 0.0)))
+    ov = summary.get("overlap", {})
+    if ov:
+        add("overlap: windows={} stall_fraction={:.3f}".format(
+            ov.get("windows", 0), ov.get("stall_fraction", 0.0)))
+    if summary.get("retries"):
+        add("job retries: {}".format(summary["retries"]))
+    spans = summary.get("spans")
+    if spans:
+        add("")
+        add("span kinds: " + ", ".join(
+            "{} ({}x, {:.2f}s)".format(cat, v.get("count", 0),
+                                       v.get("seconds", 0.0))
+            for cat, v in sorted(spans.items())))
+    tf = summary.get("trace_file")
+    add("")
+    if tf:
+        add("trace: {}  (load in https://ui.perfetto.dev or "
+            "chrome://tracing)".format(tf))
+    else:
+        add("trace: none (enable with settings.trace / DAMPR_TPU_TRACE=1)")
+    return "\n".join(lines)

@@ -152,6 +152,25 @@ def snapshot():
         return out
 
 
+def epoch():
+    """Run-scoped accounting without ``reset()``: capture the cumulative
+    counters now and difference them later with :func:`delta`.  Unlike
+    ``reset()`` this never disturbs other in-flight work's view — two
+    sequential or concurrent ``run()`` calls each hold their own epoch and
+    read their own deltas, while the process-wide counters stay monotone
+    (``snapshot()`` folds any open stall interval in, so ``codec_wait`` is
+    monotone across snapshots too)."""
+    return snapshot()
+
+
+def delta(since):
+    """Per-bucket seconds accumulated since an :func:`epoch` snapshot.
+    Clamped at zero so an interleaved ``reset()`` (legacy callers) can
+    produce a short read, never a negative one."""
+    now = snapshot()
+    return {k: max(0.0, now[k] - since.get(k, 0.0)) for k in now}
+
+
 def reset():
     global _all_since
     with _lock:

@@ -147,6 +147,8 @@ def mesh_shuffle_blocks(mesh, routed):
     sorted by seq; bytes_moved counts payload bytes that crossed the
     collective.
     """
+    from ..obs import trace as _trace
+
     global total_exchanges, total_bytes
     D = mesh_size(mesh)
     groups = {}
@@ -154,7 +156,9 @@ def mesh_shuffle_blocks(mesh, routed):
         groups.setdefault((src % D, pid % D), []).append((seq, pid, blk))
     blobs = {sd: _pack_group(items) for sd, items in groups.items()}
     moved = sum(len(b) for b in blobs.values())
-    recv = mesh_blob_exchange(mesh, blobs)
+    with _trace.span("collective", "exchange", bytes=moved,
+                     blobs=len(blobs)):
+        recv = mesh_blob_exchange(mesh, blobs)
     total_exchanges += 1
     total_bytes += moved
     out = []

@@ -361,6 +361,7 @@ def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
     double on overflow."""
     import jax
 
+    from ..obs import trace as _trace
     from ..ops import devtime
 
     capacity = max(8, int(-(-n_local // n_dev) * factor))
@@ -370,7 +371,9 @@ def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
         prog = _build_fold_program(mesh, n_dev, n_local, capacity, kind,
                                    np.dtype(v.dtype).name, axis, nonneg,
                                    gather)
-        with devtime.track("device"):
+        with devtime.track("device"), _trace.span(
+                "collective", "keyed-fold:{}".format(kind),
+                records=int(n_local * n_dev), capacity=int(capacity)):
             fh1, fh2, fv, ok, dropped = prog(h1, h2, v, valid)
             dropped = int(dropped)
         if dropped == 0:
@@ -462,7 +465,10 @@ def mesh_global_sum(mesh, v):
     def per_device(x):
         return jax.lax.psum(jnp.sum(x), axis)
 
-    out = jax.jit(_shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(axis),), out_specs=P()))(pv)
+    from ..obs import trace as _trace
+
+    with _trace.span("collective", "global-sum", records=int(total)):
+        out = jax.jit(_shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(axis),), out_specs=P()))(pv)
     return np.asarray(out).item()

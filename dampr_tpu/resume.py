@@ -430,8 +430,12 @@ def persist_stage(store, sid, fp, result, nrec):
     from .runner import _SinkOutput
     from .storage import PartitionSet
 
+    from .obs import trace as _trace
+
     if is_volatile(fp):
+        _trace.instant("checkpoint", "skip-volatile", stage=sid)
         return
+    _t0 = _trace.now()
     root = store.root
     if isinstance(result, _SinkOutput):
         manifest = {"fp": fp, "kind": "sink", "paths": result.paths,
@@ -462,6 +466,8 @@ def persist_stage(store, sid, fp, result, nrec):
         json.dump(manifest, f)
     os.replace(tmp, _manifest_path(root, sid))
     _prune(root, old_paths)
+    _trace.complete("checkpoint", "persist", _t0, stage=sid,
+                    records=nrec, kind=manifest["kind"])
 
 
 def _manifest_files(root, sid):
@@ -623,16 +629,24 @@ def load_plan(root, fps):
         if not all(os.path.exists(p) for p in paths):
             continue
         plan[sid] = m
+    from .obs import trace as _trace
+
+    _trace.instant("checkpoint", "plan", restorable=len(plan),
+                   stages=len(fps))
     return plan
 
 
 def restore_stage(root, manifest):
     """Rebuild the stage output (PartitionSet or _SinkOutput) from its
     manifest.  Returns (result, nrec)."""
+    from .obs import trace as _trace
     from .runner import _SinkOutput
     from .storage import BlockRef, PartitionSet
 
+    _t0 = _trace.now()
     if manifest["kind"] == "sink":
+        _trace.complete("checkpoint", "restore", _t0, kind="sink",
+                        records=manifest["nrec"])
         return _SinkOutput(manifest["paths"]), manifest["nrec"]
     flags = manifest.get("flags", [False, False, False])
     pset = PartitionSet(manifest["n_partitions"], hash_routed=flags[0],
@@ -640,4 +654,7 @@ def restore_stage(root, manifest):
     for pid, rel, nrecords, nbytes, kdt, vdt in manifest["blocks"]:
         pset.add(pid, BlockRef.from_disk(
             os.path.join(root, rel), nrecords, nbytes, kdt, vdt))
+    _trace.complete("checkpoint", "restore", _t0, kind="pset",
+                    records=manifest["nrec"],
+                    blocks=len(manifest["blocks"]))
     return pset, manifest["nrec"]

@@ -39,6 +39,7 @@ from . import base, settings, storage
 from .blocks import Block, BlockBuilder
 from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
 from .graph import GInput, GMap, GReduce, GSink
+from .obs import trace as _trace
 from .ops import segment
 
 log = logging.getLogger("dampr_tpu.runner")
@@ -130,6 +131,11 @@ def _overlap_stream(items, store, size_of=None):
         size_of = lambda b: b.nbytes()  # noqa: E731
     from .ops import devtime
 
+    # Each produced window records one codec span on the producer thread's
+    # lane (a no-op pass-through when tracing is off).  The span covers the
+    # generator's next() — decompress + tokenize/parse — not the queue wait.
+    items = _trace.timed_iter(items, "codec", "codec-window")
+
     q = _queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     state = {"err": None, "done": False}
@@ -186,12 +192,15 @@ def _overlap_stream(items, store, size_of=None):
                 # wait but is not codec-attributable (the ``codec``
                 # bucket doesn't count it either), and a sibling job's
                 # codec is not what this fold is blocked on.
+                wait_t0 = 0.0
                 while True:
                     try:
                         item, nb = q.get_nowait()
                         break
                     except _queue.Empty:
                         pass
+                    if not wait_t0:
+                        wait_t0 = _trace.now()
                     stalled = devtime.active_in(thread.ident, "codec")
                     if stalled:
                         devtime.slot_stall()
@@ -208,6 +217,11 @@ def _overlap_stream(items, store, size_of=None):
                     if state["done"] and q.empty():
                         item, nb = _END, 0
                         break
+                if wait_t0:
+                    # Consumer-side pipeline wait (this slot's fold was
+                    # blocked on its producer) — the per-slot view of what
+                    # devtime's codec_wait aggregates across all slots.
+                    _trace.complete("stall", "pipe-wait", wait_t0)
                 if item is _END:
                     if state["err"] is not None:
                         raise state["err"]
@@ -646,20 +660,46 @@ class OutputDataset(Dataset):
 
 class StageStats(object):
     """Per-stage observability (the reference has log lines only — SURVEY §5
-    commits to structured metrics)."""
+    commits to structured metrics).
 
-    __slots__ = ("stage_id", "kind", "n_jobs", "records_out", "seconds")
+    Beyond the original jobs/records/seconds triple this now carries the
+    stage's IO shape (records/bytes in and out, best-effort: taps whose
+    size is unknowable report 0 in) and the store-pressure deltas measured
+    while the stage ran — spill volume, merge generations, retries.  Spill
+    attribution is *causal*: a spill is charged to the stage whose
+    registration pressure evicted the block, which may have been produced
+    by an earlier stage."""
+
+    __slots__ = ("stage_id", "kind", "n_jobs", "records_in", "records_out",
+                 "bytes_in", "bytes_out", "spill_count", "spill_bytes",
+                 "merge_gens", "merge_gen_bytes", "retries", "seconds")
 
     def __init__(self, stage_id, kind):
         self.stage_id = stage_id
         self.kind = kind
         self.n_jobs = 0
+        self.records_in = 0
         self.records_out = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.spill_count = 0
+        self.spill_bytes = 0
+        self.merge_gens = 0
+        self.merge_gen_bytes = 0
+        self.retries = 0
         self.seconds = 0.0
 
     def as_dict(self):
         return {"stage": self.stage_id, "kind": self.kind,
-                "jobs": self.n_jobs, "records_out": self.records_out,
+                "jobs": self.n_jobs,
+                "records_in": self.records_in,
+                "records_out": self.records_out,
+                "bytes_in": self.bytes_in, "bytes_out": self.bytes_out,
+                "spill_count": self.spill_count,
+                "spill_bytes": self.spill_bytes,
+                "merge_gens": self.merge_gens,
+                "merge_gen_bytes": self.merge_gen_bytes,
+                "retries": self.retries,
                 "seconds": round(self.seconds, 4)}
 
 
@@ -681,9 +721,16 @@ class MTRunner(object):
         self.mesh_exchanges = 0  # general shuffles routed over all_to_all
         self.mesh_exchange_bytes = 0  # payload bytes that crossed the mesh
         self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
+        self.retries_total = 0  # transient-failure job re-executions
+        self._retry_lock = threading.Lock()
+        # Run-scoped observability (dampr_tpu.obs): the tracer is live only
+        # while settings.trace is on; run_summary (the stats.json dict) is
+        # built for every run — it is how StageStats reaches users.
+        self.tracer = None
+        self.run_summary = None
 
     # -- job fan-out --------------------------------------------------------
-    def _pool_run(self, fn, jobs, n_workers):
+    def _pool_run(self, fn, jobs, n_workers, label=None):
         retries = settings.job_retries
         if retries:
             inner = fn
@@ -699,9 +746,21 @@ class MTRunner(object):
                     except Exception:
                         if attempt == retries:
                             raise
+                        with self._retry_lock:
+                            self.retries_total += 1
+                        _trace.instant("retry", label or "job",
+                                       attempt=attempt + 1)
                         log.warning(
                             "job failed (attempt %d/%d), retrying",
                             attempt + 1, retries + 1, exc_info=True)
+
+        if label is not None and _trace.enabled():
+            traced = fn
+
+            def fn(job, _inner=traced):  # noqa: F811 - span per job, on the
+                #                          worker thread = one lane per slot
+                with _trace.span("job", label):
+                    return _inner(job)
 
         n_workers = max(1, min(n_workers, len(jobs), settings.max_processes))
         if n_workers == 1 or len(jobs) <= 1:
@@ -751,7 +810,7 @@ class MTRunner(object):
             stage, supplementary)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
-        results = self._pool_run(job, chunks, n_maps)
+        results = self._pool_run(job, chunks, n_maps, label="map")
         pset = self._collect_partitions(results, combine_op, pin,
                                         feeds_reduce, device=feeds_dev,
                                         sorted_runs=run_mode)
@@ -816,21 +875,28 @@ class MTRunner(object):
         if not runs:
             return
         fanin = self._effective_merge_fanin(runs)
+        gen = 0
         while len(runs) > fanin:
             log.info("sorted-run merge generation: %d runs over fan-in %d",
                      len(runs), fanin)
             nxt = []
-            for at in range(0, len(runs), fanin):
-                group = runs[at:at + fanin]
-                if len(group) == 1:
-                    nxt.append(group[0])
-                    continue
-                merged = self.store.register_stream(merge_sorted_streams(
-                    [r.iter_windows() for r in group]))
-                for r in group:
-                    self.store.drop_ref(r)
-                nxt.append(merged)
+            # Each generation gets its own trace lane, so Perfetto shows
+            # merge generations stacked under the map slots they follow.
+            with _trace.span("merge", "generation {}".format(gen),
+                             lane="merge gen {}".format(gen),
+                             runs=len(runs), fanin=fanin):
+                for at in range(0, len(runs), fanin):
+                    group = runs[at:at + fanin]
+                    if len(group) == 1:
+                        nxt.append(group[0])
+                        continue
+                    merged = self.store.register_stream(merge_sorted_streams(
+                        [r.iter_windows() for r in group]))
+                    for r in group:
+                        self.store.drop_ref(r)
+                    nxt.append(merged)
             runs = nxt
+            gen += 1
         pset.parts = {0: runs}
 
     def _scan_share_group(self, sid, stage, env):
@@ -912,7 +978,8 @@ class MTRunner(object):
         # Honor every member's explicit n_maps: the most restrictive wins,
         # so a stage that asked to serialize stays serialized when fused.
         n_maps = min(s.options.get("n_maps", self.n_maps) for s in stages)
-        results = self._pool_run(group_job, chunks, n_maps)
+        results = self._pool_run(group_job, chunks, n_maps,
+                                 label="map-group")
 
         ret = []
         for i in range(len(stages)):
@@ -1008,20 +1075,23 @@ class MTRunner(object):
                 if blk is None or not len(blk):
                     return
                 if combine_op is not None:
-                    partials.append(segment.fold_block(blk, combine_op))
-                    if len(partials) >= _PARTIAL_FANIN:
-                        merged = segment.fold_block(
-                            Block.concat(partials), combine_op)
-                        del partials[:]
-                        partials.append(merged)
+                    with _trace.span("fold", "partial-fold",
+                                     records=len(blk)):
+                        partials.append(segment.fold_block(blk, combine_op))
+                        if len(partials) >= _PARTIAL_FANIN:
+                            merged = segment.fold_block(
+                                Block.concat(partials), combine_op)
+                            del partials[:]
+                            partials.append(merged)
                 else:
                     raw.append(blk)
 
             def end():
                 blocks = raw
                 if combine_op is not None and partials:
-                    blocks = [segment.fold_block(
-                        Block.concat(partials), combine_op)]
+                    with _trace.span("fold", "final-fold"):
+                        blocks = [segment.fold_block(
+                            Block.concat(partials), combine_op)]
                 if sorted_run_mode:
                     out = try_sorted_run(blocks)
                     if out is not None:
@@ -1167,6 +1237,9 @@ class MTRunner(object):
         refs never get pointlessly spilled just to be deleted)."""
         limit = max(2, settings.max_files_per_stage)
         for pid, refs in list(pset.parts.items()):
+            if len(refs) > limit:
+                _trace.instant("merge", "compact", partition=pid,
+                               blocks=len(refs))
             while len(refs) > limit:
                 merged_refs = []
                 for at in range(0, len(refs), limit):
@@ -1803,7 +1876,8 @@ class MTRunner(object):
 
         n_reducers = stage.options.get("n_reducers", self.n_reducers)
         try:
-            results = self._pool_run(job, list(range(P)), n_reducers)
+            results = self._pool_run(job, list(range(P)), n_reducers,
+                                     label="reduce")
         finally:
             if exchanged is not None:
                 # The exchanged copies are intermediates private to this
@@ -1849,7 +1923,8 @@ class MTRunner(object):
             return part, n
 
         n_maps = stage.options.get("n_maps", self.n_maps)
-        results = self._pool_run(job, list(enumerate(chunks)), n_maps)
+        results = self._pool_run(job, list(enumerate(chunks)), n_maps,
+                                 label="sink")
         paths = [p for p, _ in results]
         nrec = sum(n for _, n in results)
         return _SinkOutput(paths), nrec, len(chunks)
@@ -1897,12 +1972,98 @@ class MTRunner(object):
 
     # -- main walk ---------------------------------------------------------
     def run(self, outputs, cleanup=True):
-        if settings.profile_dir:
-            import jax
+        from .ops import devtime
 
-            with jax.profiler.trace(settings.profile_dir):
-                return self._run(outputs, cleanup)
-        return self._run(outputs, cleanup)
+        wall_start = time.time()
+        epoch = devtime.epoch()
+        if settings.trace:
+            # Run-scoped engine timeline.  The tracer is process-global
+            # while active (instrumentation sites are free functions);
+            # concurrent traced runs in one process would interleave spans
+            # into the innermost tracer — run-level metrics stay exact
+            # regardless (they come from this runner's own counters).
+            self.tracer = _trace.Tracer(self.name)
+            _trace.start(self.tracer)
+        try:
+            if settings.profile_dir:
+                import jax
+
+                with jax.profiler.trace(settings.profile_dir):
+                    return self._run(outputs, cleanup)
+            return self._run(outputs, cleanup)
+        finally:
+            if self.tracer is not None:
+                _trace.stop(self.tracer)
+            try:
+                # Built on failure too: a partial timeline + stage stats
+                # is exactly what a crashed run's postmortem needs.
+                self._finalize_obs(wall_start, time.time() - wall_start,
+                                   devtime.delta(epoch))
+            except Exception:
+                log.warning("stats/trace finalize failed", exc_info=True)
+
+    def _finalize_obs(self, wall_start, wall, dev):
+        """Build the per-run summary (the stats.json payload) and, when
+        tracing, persist trace.json + stats.json under the run's trace
+        directory.  The summary is always built — it is how ``StageStats``
+        reaches users (ValueEmitter.stats()); the files are written only
+        for traced runs so untraced test/tool runs leave no litter."""
+        from .obs import export as _export
+
+        sto = self.store
+        stages = [s.as_dict() for s in self.stats]
+        summary = {
+            "schema": _export.STATS_SCHEMA,
+            "run": self.name,
+            "started_at": round(wall_start, 3),
+            "wall_seconds": round(wall, 4),
+            "n_partitions": self.n_partitions,
+            "stages": stages,
+            "totals": {
+                "records_out": sum(s["records_out"] for s in stages),
+                "bytes_out": sum(s["bytes_out"] for s in stages),
+                "spill_bytes": sum(s["spill_bytes"] for s in stages),
+            },
+            "devtime": {k: round(v, 4) for k, v in dev.items()},
+            "overlap": {
+                "windows": settings.overlap_windows,
+                "stall_fraction": (round(dev.get("codec_wait", 0.0) / wall,
+                                         4) if wall > 0 else 0.0),
+                "peak_bytes": sto.overlap_peak_bytes,
+            },
+            "store": {
+                "budget": sto.budget,
+                "spill_count": sto.spill_count,
+                "spilled_bytes": sto.spilled_bytes,
+                "merge_gens": sto.merge_gens,
+                "merge_gen_bytes": sto.merge_gen_bytes,
+                "h2d_bytes": sto.h2d_bytes,
+                "d2h_bytes": sto.d2h_bytes,
+                "hbm_offloads": sto.hbm_offloads,
+                "hbm_peak_bytes": sto.hbm_peak_bytes,
+                "overlap_peak_bytes": sto.overlap_peak_bytes,
+            },
+            "mesh": {
+                "folds": self.mesh_folds,
+                "exchanges": self.mesh_exchanges,
+                "exchange_bytes": self.mesh_exchange_bytes,
+            },
+            "streamed_assoc_folds": self.streamed_assoc_folds,
+            "retries": self.retries_total,
+            "trace_file": None,
+            "stats_file": None,
+        }
+        if self.tracer is not None:
+            summary["spans"] = self.tracer.span_summary()
+            tdir = _export.run_trace_dir(self.name)
+            os.makedirs(tdir, exist_ok=True)
+            summary["trace_file"] = _export.write_trace(
+                self.tracer, os.path.join(tdir, _export.TRACE_FILE))
+            spath = os.path.join(tdir, _export.STATS_FILE)
+            summary["stats_file"] = spath
+            _export.write_stats(summary, spath)
+            log.info("trace: %s · stats: %s", summary["trace_file"], spath)
+        self.run_summary = summary
 
     def _run(self, outputs, cleanup=True):
         from . import resume as _resume
@@ -1923,6 +2084,51 @@ class MTRunner(object):
             return self._run_stages(outputs, cleanup)
         finally:
             guard.close()
+
+    def _entry_io(self, entry):
+        """Best-effort (records, bytes) of a stage input/output entry.
+        Materialized PartitionSets and sink part files have exact sizes;
+        raw taps (Chunkers) report (None, None) — their size is unknowable
+        without reading them."""
+        if isinstance(entry, storage.PartitionSet):
+            recs = nbytes = 0
+            for r in entry.all_refs():
+                recs += len(r)
+                nbytes += r.total_bytes
+            return recs, nbytes
+        if isinstance(entry, _SinkOutput):
+            nbytes = 0
+            for p in entry.paths:
+                try:
+                    nbytes += os.path.getsize(p)
+                except OSError:
+                    pass
+            return None, nbytes
+        return None, None
+
+    def _pressure_snap(self):
+        """Store/retry counters at a stage boundary; the per-stage deltas
+        become that stage's StageStats pressure fields."""
+        sto = self.store
+        return (sto.spill_count, sto.spilled_bytes, sto.merge_gens,
+                sto.merge_gen_bytes, self.retries_total)
+
+    def _fill_stage_io(self, st, stage, env, result, snap):
+        for s in getattr(stage, "inputs", ()):
+            r, b = self._entry_io(env.get(s))
+            if r:
+                st.records_in += r
+            if b:
+                st.bytes_in += b
+        _r, b = self._entry_io(result)
+        if b:
+            st.bytes_out += b
+        sto = self.store
+        st.spill_count = sto.spill_count - snap[0]
+        st.spill_bytes = sto.spilled_bytes - snap[1]
+        st.merge_gens = sto.merge_gens - snap[2]
+        st.merge_gen_bytes = sto.merge_gen_bytes - snap[3]
+        st.retries = self.retries_total - snap[4]
 
     def _run_stages(self, outputs, cleanup):
         env = {}
@@ -1961,6 +2167,8 @@ class MTRunner(object):
                 needed.update(stage.inputs)
         for sid, stage in enumerate(self.graph.stages):
             t0 = time.time()
+            t0_span = _trace.now()
+            snap = self._pressure_snap()
             self.store.set_stage(sid)
             if isinstance(stage, GInput):
                 env[stage.output] = stage.tap
@@ -1983,7 +2191,10 @@ class MTRunner(object):
                 st.n_jobs = 0
                 st.records_out = nrec
                 st.seconds = time.time() - t0
+                self._fill_stage_io(st, stage, env, result, snap)
                 self.stats.append(st)
+                _trace.complete("stage", "s{}:{}".format(sid, st.kind),
+                                t0_span, lane="stages", records=nrec)
                 log.info("Stage %s resumed: %s", sid + 1, st.as_dict())
                 continue
             if isinstance(stage, GMap):
@@ -2015,7 +2226,10 @@ class MTRunner(object):
                     st = StageStats(sid, "map-alias")
                     st.records_out = nrec
                     st.seconds = time.time() - t0
+                    self._fill_stage_io(st, stage, env, result, snap)
                     self.stats.append(st)
+                    _trace.complete("stage", "s{}:map-alias".format(sid),
+                                    t0_span, lane="stages", records=nrec)
                     log.info("Stage %s aliased (identity checkpoint): %s",
                              sid + 1, st.as_dict())
                     continue
@@ -2058,7 +2272,10 @@ class MTRunner(object):
             st.n_jobs = njobs
             st.records_out = nrec
             st.seconds = time.time() - t0
+            self._fill_stage_io(st, stage, env, result, snap)
             self.stats.append(st)
+            _trace.complete("stage", "s{}:{}".format(sid, kind), t0_span,
+                            lane="stages", records=nrec, jobs=njobs)
             log.info("Stage %s done: %s", sid + 1, st.as_dict())
 
         sto = self.store

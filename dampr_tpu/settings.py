@@ -276,6 +276,23 @@ job_retries = 0
 #: are always available via ValueEmitter.stats regardless.
 profile_dir = os.environ.get("DAMPR_TPU_PROFILE_DIR") or None
 
+#: Run-scoped engine tracing (dampr_tpu.obs): when True every run records
+#: spans at the hot engine boundaries — codec/fold in the overlapped map
+#: driver, spill writes and k-way merge generations, mesh collectives and
+#: byte exchanges, checkpoint persist/restore, HBM tier moves — and
+#: persists a Chrome trace-event JSON (loadable in Perfetto /
+#: chrome://tracing) plus a ``stats.json`` summary under
+#: ``<scratch_root>/<run>/trace/``.  Off (the default) the span sites are
+#: a single None-check each, so the engine's hot loops pay near-zero cost.
+#: This is the engine-boundary timeline; ``profile_dir`` above remains the
+#: escape hatch for a profiler-grade XLA kernel timeline.
+trace = os.environ.get("DAMPR_TPU_TRACE", "0") not in ("0", "false", "")
+
+#: Override directory for trace/stats artifacts.  None (default) puts them
+#: under the run's scratch root, next to its durable spill/checkpoint
+#: outputs; a path pins every run's artifacts under <trace_dir>/<run>/.
+trace_dir = os.environ.get("DAMPR_TPU_TRACE_DIR") or None
+
 #: Partition-size threshold (bytes) above which a single-input reduce streams
 #: a k-way merge over hash-sorted runs instead of materializing the partition
 #: (groups then arrive in hash order, not key order).  None = use

@@ -26,6 +26,7 @@ import uuid
 import numpy as np
 
 from . import settings
+from .obs import trace as _trace
 
 log = logging.getLogger("dampr_tpu.storage")
 
@@ -126,7 +127,9 @@ class BlockRef(object):
 
         h1, h2 = block.hashes()
         lane_vals, self.lane_abs, self.lane_min = prep
-        with devtime.track("transfer"):
+        with devtime.track("transfer"), _trace.span(
+                "hbm", "h2d", bytes=int(lane_vals.nbytes + h1.nbytes
+                                        + h2.nbytes)):
             self._dev = (jax.device_put(lane_vals), jax.device_put(h1),
                          jax.device_put(h2))
         self.dev_bytes = lane_vals.nbytes + h1.nbytes + h2.nbytes
@@ -523,6 +526,7 @@ class RunStore(object):
         total_records = 0
         total_bytes = 0
         key_dtype = value_dtype = np.dtype(object)
+        t0 = _trace.now()
         try:
             for blk in blocks:
                 if not len(blk):
@@ -573,6 +577,8 @@ class RunStore(object):
         with self._lock:
             self.merge_gens += 1
             self.merge_gen_bytes += total_bytes
+        _trace.complete("merge", "merge-run", t0, bytes=total_bytes,
+                        records=total_records)
         return ref
 
     def _select_dev_victims_locked(self):
@@ -608,10 +614,15 @@ class RunStore(object):
         directory = os.path.join(self.root, self._stage)
         freed = 0
         for v in evicted_dev:
-            v.offload()
-            freed += v.spill(directory)
+            with _trace.span("hbm", "offload", bytes=v.dev_bytes):
+                v.offload()
+            with _trace.span("spill", "spill", bytes=v.nbytes,
+                             records=v.nrecords):
+                freed += v.spill(directory)
         for v in victims:
-            freed += v.spill(directory)
+            with _trace.span("spill", "spill", bytes=v.nbytes,
+                             records=v.nrecords):
+                freed += v.spill(directory)
         with self._lock:
             self.spill_count += len(victims) + len(evicted_dev)
             self.spilled_bytes += freed
@@ -621,7 +632,8 @@ class RunStore(object):
         """Device -> host for one ref already removed from both resident
         lists (outside the lock), then re-enter it as a plain host ref,
         which may cascade to a disk spill."""
-        freed, _delta = ref.offload()
+        with _trace.span("hbm", "offload", bytes=ref.dev_bytes):
+            freed, _delta = ref.offload()
         if not freed:
             return  # raced with a concurrent drop
         with self._lock:

@@ -24,6 +24,9 @@ MODULES = [
     "dampr_tpu.graph",
     "dampr_tpu.runner",
     "dampr_tpu.storage",
+    "dampr_tpu.obs",
+    "dampr_tpu.obs.trace",
+    "dampr_tpu.obs.export",
     "dampr_tpu.resume",
     "dampr_tpu.settings",
     "dampr_tpu.ops.hashing",

@@ -1,0 +1,254 @@
+"""Run-scoped tracing + stats (dampr_tpu.obs): trace emission at the hot
+boundaries, Chrome trace-event schema validity, stats.json structure, the
+ValueEmitter.stats() accessor, and per-stage spill attribution."""
+
+import importlib.util
+import json
+import operator
+import os
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.obs import export, trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "validate_trace", os.path.join(ROOT, "tools", "validate_trace.py"))
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+with open(os.path.join(ROOT, "docs", "trace_schema.json")) as _f:
+    TRACE_SCHEMA = json.load(_f)
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Enable tracing for one test, artifacts under tmp_path."""
+    old_trace, old_dir = settings.trace, settings.trace_dir
+    settings.trace = True
+    settings.trace_dir = str(tmp_path)
+    yield tmp_path
+    settings.trace = old_trace
+    settings.trace_dir = old_dir
+
+
+def _corpus(tmp_path, lines=4000):
+    path = tmp_path / "corpus.txt"
+    words = ["alpha", "beta", "gamma", "delta", "tok%d" % 7, "zz"]
+    with open(path, "w") as f:
+        for i in range(lines):
+            f.write(" ".join(words[(i + j) % len(words)]
+                             for j in range(8)) + "\n")
+    return str(path)
+
+
+def _load_trace(summary):
+    assert summary["trace_file"] and os.path.isfile(summary["trace_file"])
+    with open(summary["trace_file"]) as f:
+        return json.load(f)
+
+
+def _cats(doc):
+    return {ev.get("cat") for ev in doc["traceEvents"]
+            if ev.get("ph") in ("X", "i")}
+
+
+class TestTracedRuns:
+    def test_tfidf_shape_kinds_and_schema(self, traced, tmp_path):
+        """The bench-shaped workload (block codec -> fold) emits codec,
+        fold, stage and job spans on per-slot lanes, and the trace
+        validates against the checked-in schema."""
+        from dampr_tpu.ops.text import DocFreq
+
+        corpus = _corpus(tmp_path)
+        docs = Dampr.text(corpus, chunk_size=16 * 1024)
+        em = (docs.custom_mapper(
+                  DocFreq(mode="word", lower=True, pair_values=False))
+              .fold_values(operator.add)
+              .run(name="obs-tfidf"))
+        counts = dict(em.read())
+        assert counts and all(c > 0 for c in counts.values())
+        summary = em.stats()
+        doc = _load_trace(summary)
+        errors = validate_trace.validate(doc, TRACE_SCHEMA)
+        assert not errors, errors
+        cats = _cats(doc)
+        assert {"codec", "fold", "stage", "job"} <= cats, cats
+        # per-slot lanes: more than one named lane (pool workers + codec
+        # producer threads), each declared via thread_name metadata
+        lanes = [ev for ev in doc["traceEvents"]
+                 if ev.get("ph") == "M" and ev["name"] == "thread_name"]
+        assert len(lanes) >= 2, lanes
+        assert any("codec" in ev["args"]["name"] for ev in lanes), (
+            "codec producer threads should appear as their own lanes")
+        em.delete()
+
+    def test_mesh_fold_emits_collective_spans(self, traced):
+        """On the 8-device test mesh the associative fold rides the
+        collective path and records collective spans."""
+        em = (Dampr.memory(list(range(20000)))
+              .map(lambda x: (x % 31, 1))
+              .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+              .run(name="obs-mesh"))
+        out = dict(em.read())
+        assert sum(out.values()) == 20000
+        doc = _load_trace(em.stats())
+        assert "collective" in _cats(doc), _cats(doc)
+        em.delete()
+
+    def test_sort_spill_merge_kinds_and_attribution(self, traced, tmp_path):
+        """A budget-squeezed external sort emits spill + merge spans, and
+        the per-stage spill-bytes sum equals the store's measured spill
+        volume (same counter, stage-boundary snapshots)."""
+        from dampr_tpu.ops.text import ParseNumbers
+        from dampr_tpu.runner import MTRunner
+
+        path = tmp_path / "nums.txt"
+        with open(path, "w") as f:
+            for i in range(60000):
+                f.write("{}\n".format((i * 2654435761) % (1 << 40)))
+        old_fanin, old_dev = settings.merge_fanin, settings.use_device
+        settings.merge_fanin = 2
+        settings.use_device = False
+        try:
+            pipe = (Dampr.text(str(path), chunk_size=64 * 1024)
+                    .custom_mapper(ParseNumbers())
+                    .checkpoint(force=True))
+            runner = MTRunner("obs-sort", pipe.pmer.graph,
+                              memory_budget=1 << 18)
+            out = runner.run([pipe.source])
+            n = sum(len(b) for b in out[0].sorted_blocks())
+            assert n == 60000
+        finally:
+            settings.merge_fanin = old_fanin
+            settings.use_device = old_dev
+        summary = runner.run_summary
+        assert summary["store"]["spilled_bytes"] > 0
+        assert summary["store"]["merge_gens"] > 0
+        assert sum(s["spill_bytes"] for s in summary["stages"]) == \
+            summary["store"]["spilled_bytes"]
+        assert sum(s["merge_gens"] for s in summary["stages"]) == \
+            summary["store"]["merge_gens"]
+        doc = _load_trace(summary)
+        errors = validate_trace.validate(doc, TRACE_SCHEMA)
+        assert not errors, errors
+        assert {"spill", "merge", "stage", "job"} <= _cats(doc)
+        out[0].delete()
+
+    def test_checkpoint_spans_on_resume(self, traced, tmp_path):
+        """Durable runs record checkpoint persist spans; reruns record
+        restores."""
+        src = Dampr.memory(list(range(500))).map(lambda x: x + 1)
+        em = src.run(name="obs-ckpt", resume=True)
+        assert "checkpoint" in _cats(_load_trace(em.stats()))
+        em2 = src.run(name="obs-ckpt", resume=True)
+        doc2 = _load_trace(em2.stats())
+        restores = [ev for ev in doc2["traceEvents"]
+                    if ev.get("cat") == "checkpoint"
+                    and ev.get("name") == "restore"]
+        assert restores, "rerun should restore from checkpoint"
+        em2.delete()
+
+
+class TestStatsSurface:
+    def test_accessor_and_backcompat(self):
+        em = Dampr.memory([1, 2, 3]).map(lambda x: x * 2).run()
+        # historical shape: a list of per-stage dicts
+        assert em.stats and isinstance(em.stats[0], dict)
+        assert {"jobs", "records_out", "seconds"} <= set(em.stats[0])
+        # extended per-stage fields
+        assert {"bytes_in", "bytes_out", "spill_bytes",
+                "records_in"} <= set(em.stats[0])
+        # the accessor: full run summary
+        summary = em.stats()
+        assert summary["schema"] == export.STATS_SCHEMA
+        assert summary["stages"] == list(em.stats)
+        assert summary["wall_seconds"] >= 0
+        assert "devtime" in summary and "store" in summary
+        # untraced runs persist nothing
+        assert summary["trace_file"] is None
+        em.delete()
+
+    def test_stats_json_persisted_and_locatable(self, traced):
+        em = Dampr.memory(list(range(100))).map(lambda x: x).run(
+            name="obs-locate")
+        summary = em.stats()
+        spath = summary["stats_file"]
+        assert spath and os.path.isfile(spath)
+        loaded, path = export.load_stats("obs-locate")
+        assert path == spath
+        assert loaded["run"] == "obs-locate"
+        assert loaded["stages"]
+        # formatting never raises and mentions the trace
+        text = export.format_summary(loaded)
+        assert "obs-locate" in text and "trace" in text
+        em.delete()
+
+    def test_bytes_in_out_tracked_across_stages(self):
+        em = (Dampr.memory(list(range(5000)))
+              .map(lambda x: (x % 7, x))
+              .checkpoint(force=True)
+              .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+              .run())
+        by_kind = {}
+        for s in em.stats:
+            by_kind.setdefault(s["kind"], []).append(s)
+        assert "reduce" in by_kind
+        red = by_kind["reduce"][0]
+        assert red["records_in"] > 0 and red["bytes_in"] > 0
+        assert red["bytes_out"] > 0
+        em.delete()
+
+
+class TestTracerCore:
+    def test_disabled_span_is_shared_noop(self):
+        assert not trace.enabled()
+        s1 = trace.span("x", "a")
+        s2 = trace.span("x", "b", arg=1)
+        assert s1 is s2  # the shared no-op: no allocation when off
+        with s1:
+            pass
+        assert trace.now() == 0.0
+        it = iter([1, 2])
+        assert trace.timed_iter(it, "x", "y") is it
+
+    def test_span_collection_and_lanes(self):
+        t = trace.Tracer("unit")
+        trace.start(t)
+        try:
+            with trace.span("cat1", "outer", n=3):
+                trace.instant("cat2", "mark")
+            with trace.span("cat1", "lane-span", lane="custom lane"):
+                pass
+        finally:
+            trace.stop(t)
+        assert not trace.enabled()
+        cats = {e[0] for e in t.events}
+        assert cats == {"cat1", "cat2"}
+        assert "custom lane" in t.lane_names.values()
+        agg = t.span_summary()
+        assert agg["cat1"]["count"] == 2
+        # events emitted after stop are dropped (no active tracer)
+        before = len(t.events)
+        with trace.span("cat1", "late"):
+            pass
+        assert len(t.events) == before
+
+    def test_chrome_export_round_trip(self, tmp_path):
+        t = trace.Tracer("unit2")
+        trace.start(t)
+        try:
+            with trace.span("k", "s", bytes=10):
+                pass
+            trace.instant("k", "i")
+        finally:
+            trace.stop(t)
+        path = export.write_trace(t, str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        errors = validate_trace.validate(doc, TRACE_SCHEMA)
+        assert not errors, errors
+        phs = [e["ph"] for e in doc["traceEvents"]]
+        assert "X" in phs and "i" in phs and "M" in phs

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Validate a dampr_tpu trace.json against docs/trace_schema.json.
+
+Dependency-free (CI and containers without jsonschema): implements the
+JSON-Schema subset the checked-in schema uses — type, required,
+properties, items, enum, minItems — plus the trace-event phase rules the
+schema prose defers here:
+
+- ``X`` (complete) events carry numeric ``ts`` and ``dur``;
+- ``i`` (instant) events carry numeric ``ts`` and a scope ``s``;
+- ``M`` (metadata) events are ``process_name``/``thread_name`` records;
+- at least one ``thread_name`` metadata event exists (lanes are named).
+
+Usage::
+
+    python tools/validate_trace.py TRACE.json [--schema docs/trace_schema.json]
+                                   [--require-cats codec,fold,spill]
+
+``--require-cats`` additionally asserts each listed span category appears
+on at least one X/i event (the bench smoke job pins the kinds the traced
+workload must produce).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "integer": int,
+    "boolean": bool,
+}
+
+
+def _check(instance, schema, path, errors):
+    typ = schema.get("type")
+    if typ == "number":
+        if not isinstance(instance, (int, float)) or isinstance(
+                instance, bool):
+            errors.append("{}: expected number, got {!r}".format(
+                path, type(instance).__name__))
+            return
+    elif typ is not None:
+        py = _TYPES.get(typ)
+        if py is None:
+            errors.append("{}: unsupported schema type {!r}".format(
+                path, typ))
+            return
+        if not isinstance(instance, py) or (
+                typ == "integer" and isinstance(instance, bool)):
+            errors.append("{}: expected {}, got {!r}".format(
+                path, typ, type(instance).__name__))
+            return
+    if "enum" in schema and instance not in schema["enum"]:
+        errors.append("{}: {!r} not in {}".format(
+            path, instance, schema["enum"]))
+    if isinstance(instance, dict):
+        for req in schema.get("required", ()):
+            if req not in instance:
+                errors.append("{}: missing required key {!r}".format(
+                    path, req))
+        props = schema.get("properties", {})
+        for key, sub in props.items():
+            if key in instance:
+                _check(instance[key], sub, "{}.{}".format(path, key),
+                       errors)
+    if isinstance(instance, list):
+        if len(instance) < schema.get("minItems", 0):
+            errors.append("{}: fewer than minItems={} items".format(
+                path, schema["minItems"]))
+        items = schema.get("items")
+        if items is not None:
+            for i, item in enumerate(instance):
+                _check(item, items, "{}[{}]".format(path, i), errors)
+                if len(errors) > 50:
+                    return  # enough to diagnose; don't drown the output
+
+
+def _phase_rules(events, errors):
+    named_lanes = 0
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        where = "traceEvents[{}]".format(i)
+        if ph == "X":
+            if not isinstance(ev.get("ts"), (int, float)):
+                errors.append(where + ": X event without numeric ts")
+            if not isinstance(ev.get("dur"), (int, float)):
+                errors.append(where + ": X event without numeric dur")
+        elif ph == "i":
+            if not isinstance(ev.get("ts"), (int, float)):
+                errors.append(where + ": i event without numeric ts")
+            if ev.get("s") not in ("t", "p", "g"):
+                errors.append(where + ": i event without scope s")
+        elif ph == "M":
+            if ev.get("name") == "thread_name":
+                named_lanes += 1
+        if len(errors) > 50:
+            return
+    if not named_lanes:
+        errors.append("no thread_name metadata: lanes are unnamed")
+
+
+def validate(doc, schema, require_cats=()):
+    """Return a list of error strings (empty = valid)."""
+    errors = []
+    _check(doc, schema, "$", errors)
+    events = doc.get("traceEvents")
+    if isinstance(events, list):
+        _phase_rules(events, errors)
+        cats = {ev.get("cat") for ev in events
+                if ev.get("ph") in ("X", "i")}
+        for want in require_cats:
+            if want not in cats:
+                errors.append(
+                    "required span category {!r} absent (have: {})".format(
+                        want, ", ".join(sorted(c for c in cats if c))))
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="validate a dampr_tpu Chrome trace-event JSON")
+    ap.add_argument("trace")
+    ap.add_argument("--schema", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "trace_schema.json"))
+    ap.add_argument("--require-cats", default="",
+                    help="comma-separated span categories that must appear")
+    args = ap.parse_args(argv)
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+    with open(args.schema) as f:
+        schema = json.load(f)
+    cats = [c for c in args.require_cats.split(",") if c]
+    errors = validate(doc, schema, cats)
+    if errors:
+        for e in errors:
+            print("INVALID: {}".format(e), file=sys.stderr)
+        return 1
+    n = len(doc["traceEvents"])
+    print("OK: {} events, {} categories".format(
+        n, len({ev.get("cat") for ev in doc["traceEvents"]
+                if ev.get("cat")})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
