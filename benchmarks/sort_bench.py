@@ -36,6 +36,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mb", type=int, default=256)
     ap.add_argument("--budget-mb", type=int, default=64)
+    ap.add_argument("--chunk-mb", type=int, default=32,
+                    help="map chunk size (smaller -> more sorted runs; "
+                         "combine with DAMPR_TPU_MERGE_FANIN to force "
+                         "in-run merge generations)")
     ap.add_argument("--dir", default="/tmp/dampr_tpu_bench")
     args = ap.parse_args()
 
@@ -66,7 +70,7 @@ def main():
     t0 = time.time()
     # Vectorized external sort: parse lines to int64 keys in C, hash-sorted
     # spill runs, bounded merge; records come back in ascending key order.
-    pipe = (Dampr.text(path, chunk_size=32 * 1024 ** 2)
+    pipe = (Dampr.text(path, chunk_size=args.chunk_mb * 1024 ** 2)
             .custom_mapper(ParseNumbers())
             .checkpoint(force=True))
     runner = MTRunner("sort-bench", pipe.pmer.graph)
@@ -89,6 +93,23 @@ def main():
         print("COMPLETENESS VIOLATION: {} != {}".format(n, expected),
               file=sys.stderr)
         sys.exit(1)
+    # I/O shape from the store's live counters (not run_summary: the
+    # summary freezes when run() returns, and the merge-read loop above —
+    # the bench's dominant read side — happens after that).
+    sto = runner.store
+    io = {
+        "spill_write_mbps": (round(sto.spill_disk_bytes / 1e6
+                                   / sto.spill_write_seconds, 2)
+                             if sto.spill_write_seconds > 1e-9 else 0.0),
+        "spill_read_mbps": (round(sto.spill_read_bytes / 1e6
+                                  / sto.spill_read_seconds, 2)
+                            if sto.spill_read_seconds > 1e-9 else 0.0),
+        "io_wait_seconds": round(sto.io_wait_seconds, 4),
+        "io_wait_fraction": round(sto.io_wait_seconds / secs, 4),
+        "io_wait_write_fraction": round(
+            sto.io_wait_write_seconds / secs, 4),
+        "writer_threads": settings.spill_write_threads,
+    }
 
     print(json.dumps({
         "metric": "external_sort_throughput",
@@ -111,6 +132,17 @@ def main():
         "stage_spill_mb": round(sum(
             s["spill_bytes"] for s in runner.run_summary["stages"]) / 1e6,
             1) if runner.run_summary else None,
+        # Async spill I/O shape (dampr_tpu.io, from RunStats "io"): disk
+        # bandwidth on each side and the fold-side stall fraction — the
+        # acceptance gauge for the background writer/prefetch subsystem
+        # (io_wait_fraction < 0.10 means folds almost never blocked on
+        # codec+disk).
+        "spill_write_mbps": io.get("spill_write_mbps"),
+        "spill_read_mbps": io.get("spill_read_mbps"),
+        "io_wait_fraction": io.get("io_wait_fraction"),
+        "io_wait_write_fraction": io.get("io_wait_write_fraction"),
+        "io_wait_seconds": io.get("io_wait_seconds"),
+        "spill_writer_threads": io.get("writer_threads"),
         "trace_file": (runner.run_summary or {}).get("trace_file"),
     }))
 

@@ -19,11 +19,18 @@ bugs (base.py:355, 366) and are not part of the public DSL; we implement the
 two exposed joins (inner/left) plus the map-side crosses.
 """
 
+import copy
+import functools
 import itertools
+import logging
+import threading
+import types
 
 import numpy as np
 
 from .ops import hashing, segment
+
+log = logging.getLogger("dampr_tpu.base")
 
 
 class Splitter(object):
@@ -40,19 +47,103 @@ class Splitter(object):
 # Mappers
 # ---------------------------------------------------------------------------
 
+#: Callable types that are always safe to share by reference: plain
+#: functions/builtins are atomic to deepcopy, and a closure's captured
+#: state is the user's explicit choice (same as under the fork-based
+#: reference's exec model).  Bound methods are NOT here — deepcopy
+#: copies their ``__self__``, and a bound method of a stateful object is
+#: exactly the shared-mutable-UDF hazard this machinery isolates.
+_ATOMIC_CALLABLE_TYPES = (types.FunctionType, types.BuiltinFunctionType,
+                          types.BuiltinMethodType, type)
+
+_share_warned = set()
+_share_warned_lock = threading.Lock()
+
+
+def _stateful_callable(v, _depth=0):
+    """A callable *object* carrying per-instance state (nonempty
+    ``__dict__``): shared across concurrent jobs it would observe every
+    partition's records interleaved and must be thread-safe — so the
+    per-job clone isolates it instead (the thread-pool analog of the
+    fork-based reference's copy-on-write worker isolation).  Detected
+    when held directly, inside ``functools.partial``, or one or two
+    levels down a plain list/tuple/dict (deepcopy then clones the whole
+    holding structure); state buried deeper than that stays shared —
+    the documented must-be-thread-safe contract."""
+    if _depth > 2:
+        return False
+    if isinstance(v, functools.partial):
+        return (_stateful_callable(v.func, _depth + 1)
+                or any(_stateful_callable(a, _depth + 1) for a in v.args)
+                or any(_stateful_callable(a, _depth + 1)
+                       for a in (v.keywords or {}).values()))
+    if isinstance(v, (list, tuple)):
+        return any(_stateful_callable(x, _depth + 1) for x in v)
+    if isinstance(v, dict):
+        return any(_stateful_callable(x, _depth + 1) for x in v.values())
+    if isinstance(v, types.MethodType):
+        # A bound method mutates its receiver: stateful iff the receiver
+        # carries instance state (deepcopy of the method clones
+        # ``__self__``, so isolation works the same way).
+        recv = v.__self__
+        if isinstance(recv, type):
+            return False  # classmethod: class-level state, always shared
+        return bool(getattr(recv, "__dict__", None))
+    if not callable(v) or isinstance(v, _ATOMIC_CALLABLE_TYPES):
+        return False
+    return bool(getattr(v, "__dict__", None))
+
+
 def _shared_instance_deepcopy(self, memo):
-    """``__deepcopy__`` body for operators with no per-chunk state: the
-    runner's per-job clone (runner._clone_op) shares the instance, so the
-    user callable inside is never deep-copied — it may hold uncopyable
-    resources (open files, sockets, loaded models).  Trade-off, stated
-    honestly: the fork-based reference gave mutating UDFs copy-on-write
-    isolation per worker; a thread-pool runner cannot, so a callable
-    *object* that mutates its own attributes now shares that state across
-    concurrent jobs and must be thread-safe (plain functions/closures were
-    always shared — deepcopy treats functions as atomic).  Per-job mutable
-    state belongs in the BlockMapper/BlockReducer lifecycle, which IS
+    """``__deepcopy__`` body for the stateless wrapper operators: the
+    runner's per-job clone (runner._clone_op) shares the instance when
+    everything it holds is safely shareable — plain functions, closures,
+    builtins, bound methods (deepcopy treats them as atomic; they were
+    always shared).
+
+    A held callable *object* with a nonempty ``__dict__`` is different:
+    it has per-instance state, and sharing one across concurrent jobs
+    silently interleaves every partition's records through it (the fork-
+    based reference gave such UDFs copy-on-write isolation per worker).
+    So the wrapper deep-copies itself — reaching the stateful callable —
+    and each job gets its own instance.  Callables whose state resists
+    deepcopy (open files, sockets, loaded models) fall back to the shared
+    instance with a once-per-type warning: they must then be thread-safe,
+    the documented pre-fix contract.  Truly per-chunk mutable state still
+    belongs in the BlockMapper/BlockReducer lifecycle, which is always
     deep-copied."""
-    return self
+    held = getattr(self, "__dict__", None) or {}
+    if not any(_stateful_callable(v) for v in held.values()):
+        return self
+    pre_keys = set(memo)
+    try:
+        cls = self.__class__
+        clone = cls.__new__(cls)
+        memo[id(self)] = clone
+        for k, v in held.items():
+            object.__setattr__(clone, k, copy.deepcopy(v, memo))
+        return clone
+    except Exception as e:
+        # Un-poison the memo: it was seeded with the half-built clone
+        # before the child copies ran (required for cycles), and children
+        # copied before the failure may hold back-references to that
+        # discarded clone — drop every entry this attempt added (except
+        # deepcopy's own id(memo) keep-alive list), then map self to the
+        # shared original so later references resolve consistently.
+        for k in set(memo) - pre_keys:
+            if k != id(memo):
+                memo.pop(k, None)
+        memo[id(self)] = self
+        key = type(self).__name__
+        with _share_warned_lock:
+            seen = key in _share_warned
+            _share_warned.add(key)
+        if not seen:
+            log.warning(
+                "%s holds a stateful callable object whose state cannot "
+                "be deep-copied (%s); the instance is SHARED across "
+                "concurrent jobs and must be thread-safe", key, e)
+        return self
 
 
 class Mapper(object):
@@ -174,9 +265,11 @@ class RecordOp(Mapper, Streamable):
     records in stream order, so self-contained stateful UDFs (a dedupe
     filter's seen-set) behave the same within one stream.  Only state
     shared ACROSS two ops of one chain could observe the difference; batch
-    size bounds it.  Note that UDF instances are shared across concurrent
-    jobs (see ``_shared_instance_deepcopy``): a mutating callable-object
-    UDF observes all partitions' records and must be thread-safe."""
+    size bounds it.  UDF sharing across concurrent jobs (see
+    ``_shared_instance_deepcopy``): plain functions/closures are shared;
+    a stateful callable *object* is deep-copied per job where possible,
+    and only falls back to the shared instance — which must then be
+    thread-safe — when its state defies deepcopy."""
 
     def map(self, *datasets):
         assert len(datasets) == 1
@@ -185,8 +278,9 @@ class RecordOp(Mapper, Streamable):
     def apply_batch(self, ks, vs):
         raise NotImplementedError()
 
-    # No per-chunk state (Sample re-derives its RNG per stream), so per-job
-    # clones share the instance and never deep-copy the user callable.
+    # No per-chunk state of its own (Sample re-derives its RNG per
+    # stream): clones share the wrapper unless a held stateful callable
+    # object needs per-job isolation (_shared_instance_deepcopy).
     __deepcopy__ = _shared_instance_deepcopy
 
 

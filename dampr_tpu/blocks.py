@@ -320,7 +320,13 @@ def merge_sorted_streams(streams):
     rejects NaN keys up front.  Memory holds one
     in-flight window per stream — never a whole run — so merging hundreds
     of spilled runs stays budget-bounded while every run file is read
-    strictly sequentially.
+    strictly sequentially.  Spilled runs in the chunked-frame format
+    additionally keep ``settings.spill_read_prefetch`` frames of bounded
+    readahead in flight per stream on the shared read executor
+    (storage.iter_block_windows), so frame decompression across the k
+    runs proceeds in parallel underneath this merge instead of
+    serializing on each ``next()``; the merge planner's fan-in clamp
+    already budgets that extra window of headroom per run.
 
     Round structure: the *bound* is the smallest last-key among the
     streams' current windows.  Every record ``<= bound`` anywhere is

@@ -1,0 +1,19 @@
+"""Asynchronous spill/merge I/O: chunked-frame spill files, pluggable
+codecs, a budget-charged background writer pool, and prefetching frame
+readers.
+
+The storage engine's I/O layer, factored out of :mod:`dampr_tpu.storage`
+so the pieces compose: :mod:`.frames` defines the on-disk format (length-
+prefixed independently compressed frames + an index footer, coexisting
+with legacy gzip/pickle spills via magic sniffing), :mod:`.codecs` the
+per-frame compression registry (raw/zlib/gzip always; lz4/zstd when
+installed, with graceful fallback), and :mod:`.writer` the bounded
+background writer pool whose in-flight bytes are charged against the
+stage memory budget — see ``docs/spill_format.md`` for the format spec
+and README "Spill I/O" for the knobs.
+"""
+
+from .codecs import Codec, MissingCodecError, available, resolve  # noqa: F401
+from .frames import (FrameFormatError, FrameReader, FrameWriter,  # noqa: F401
+                     is_frame_file)
+from .writer import SpillWriterPool  # noqa: F401

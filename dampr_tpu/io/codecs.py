@@ -1,0 +1,187 @@
+"""Pluggable frame codecs for the chunked spill format.
+
+Every spill frame records which codec compressed it as a one-byte id, so
+files written under different ``settings`` configurations — or by
+different dampr_tpu versions — coexist in one run directory and decode
+correctly.  The registry is deliberately tiny:
+
+======  ====  ==========================================================
+name    id    notes
+======  ====  ==========================================================
+raw     0     no compression (numeric lanes are mostly high-entropy)
+zlib    1     raw DEFLATE stream, level from ``settings.compress_level``
+              (or ``"zlib:N"``) — no gzip header/CRC per frame
+gzip    2     gzip member bytes; kept for parity with the legacy
+              whole-file format (``gzip.decompress`` both ways)
+lz4     3     ``lz4.frame`` — optional dependency
+zstd    4     ``zstandard`` — optional dependency
+======  ====  ==========================================================
+
+The optional codecs degrade gracefully: *encoding* with an unavailable
+codec falls back down the ``zstd -> lz4 -> zlib`` ladder with a one-time
+warning (a config naming a codec the host lacks must not fail the run),
+while *decoding* a frame whose codec module is missing raises — the
+bytes cannot be conjured, and the error names the missing module.
+"""
+
+import gzip
+import logging
+import zlib
+
+log = logging.getLogger("dampr_tpu.io.codecs")
+
+RAW, ZLIB, GZIP, LZ4, ZSTD = 0, 1, 2, 3, 4
+
+_NAMES = {RAW: "raw", ZLIB: "zlib", GZIP: "gzip", LZ4: "lz4", ZSTD: "zstd"}
+_IDS = {v: k for k, v in _NAMES.items()}
+_IDS["none"] = RAW
+
+_warned = set()
+
+
+def _warn_once(key, msg, *args):
+    if key not in _warned:
+        _warned.add(key)
+        log.warning(msg, *args)
+
+
+class Codec(object):
+    """One (id, name, level) encoder/decoder pair.  Instances are cheap
+    value objects; ``compress``/``decompress`` operate on whole frame
+    payloads (bounded by the spill window, so a few MB at most)."""
+
+    __slots__ = ("cid", "name", "level")
+
+    def __init__(self, cid, level=None):
+        self.cid = cid
+        self.name = _NAMES[cid]
+        self.level = level
+
+    def __repr__(self):
+        if self.level is None:
+            return "Codec[{}]".format(self.name)
+        return "Codec[{}:{}]".format(self.name, self.level)
+
+    def compress(self, data):
+        if self.cid == RAW:
+            return data
+        if self.cid == ZLIB:
+            return zlib.compress(data, self.level)
+        if self.cid == GZIP:
+            return gzip.compress(data, compresslevel=self.level)
+        if self.cid == LZ4:
+            import lz4.frame
+
+            return lz4.frame.compress(data, compression_level=self.level)
+        if self.cid == ZSTD:
+            import zstandard
+
+            return zstandard.ZstdCompressor(level=self.level).compress(data)
+        raise ValueError("unknown codec id {}".format(self.cid))
+
+    def decompress(self, data):
+        return decompress(self.cid, data)
+
+
+def decompress(cid, data):
+    """Decode one frame payload by its recorded codec id.  Raises
+    ``MissingCodecError`` when the frame needs an optional module the
+    host doesn't have — the file is fine, the environment is short."""
+    if cid == RAW:
+        return data
+    if cid == ZLIB:
+        return zlib.decompress(data)
+    if cid == GZIP:
+        return gzip.decompress(data)
+    if cid == LZ4:
+        try:
+            import lz4.frame
+        except ImportError:
+            raise MissingCodecError(
+                "spill frame compressed with lz4 but the 'lz4' module is "
+                "not installed (pip install lz4)")
+        return lz4.frame.decompress(data)
+    if cid == ZSTD:
+        try:
+            import zstandard
+        except ImportError:
+            raise MissingCodecError(
+                "spill frame compressed with zstd but the 'zstandard' "
+                "module is not installed (pip install zstandard)")
+        return zstandard.ZstdDecompressor().decompress(data)
+    raise MissingCodecError("unknown spill frame codec id {}".format(cid))
+
+
+class MissingCodecError(RuntimeError):
+    """A frame's codec module is unavailable on this host."""
+
+
+def available(name):
+    """Is ``name`` usable for encoding on this host?"""
+    if name in ("raw", "none", "zlib", "gzip"):
+        return True
+    if name == "lz4":
+        try:
+            import lz4.frame  # noqa: F401
+            return True
+        except ImportError:
+            return False
+    if name == "zstd":
+        try:
+            import zstandard  # noqa: F401
+            return True
+        except ImportError:
+            return False
+    return False
+
+
+#: Default-codec preference ladder for ``spill_codec = "auto"`` and for
+#: falling back from an unavailable explicit choice: best compression/
+#: speed trade first, stdlib always last.
+_LADDER = ("zstd", "lz4", "zlib")
+
+_DEFAULT_LEVELS = {
+    # zlib/gzip reuse settings.compress_level (historically 1 = fast);
+    # lz4/zstd levels live on their own scales.
+    "lz4": 0,   # lz4.frame default (fast)
+    "zstd": 3,  # zstandard default
+}
+
+
+def resolve(name, default_level=1):
+    """Name (``"zlib"``, ``"zlib:6"``, ``"auto"``, ...) -> :class:`Codec`,
+    falling back down the ladder with a one-time warning when an optional
+    codec is missing."""
+    spec = str(name).lower()
+    name = spec
+    level = None
+    if ":" in name:
+        name, _, lev = name.partition(":")
+        try:
+            level = int(lev)
+        except ValueError:
+            raise ValueError("bad codec level in {!r}".format(spec))
+    if name != "auto" and name not in _IDS:
+        raise ValueError("unknown spill codec {!r}".format(name))
+    if name == "auto":
+        for cand in _LADDER:
+            if available(cand):
+                name = cand
+                break
+    elif name not in ("raw", "none") and not available(name):
+        for cand in _LADDER:
+            if available(cand):
+                _warn_once(("fallback", name),
+                           "spill codec %r unavailable; falling back to %r",
+                           name, cand)
+                name = cand
+                # The explicit level belonged to the requested codec's
+                # scale (zstd goes to 22, zlib stops at 9): carrying it
+                # over could fail the fallback's first compress — use the
+                # fallback's own default instead.
+                level = None
+                break
+    cid = _IDS[name]
+    if level is None:
+        level = _DEFAULT_LEVELS.get(name, default_level)
+    return Codec(cid, level)

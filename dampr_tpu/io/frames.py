@@ -1,0 +1,291 @@
+"""Chunked-frame spill files: independently compressed, length-prefixed
+frames with an index footer.
+
+The legacy spill wire format (one pickle-window stream, optionally inside
+a single gzip member) forces strictly serial decode: gzip state threads
+through the whole file, so a merge reader can neither decompress frames
+in parallel nor skip ahead.  This format keeps the same *payloads* — one
+pickled columnar ``(keys, values, h1, h2)`` window per frame — but frames
+compress independently and the footer indexes every frame, so:
+
+- frames decompress in parallel (and out of order) on a reader pool;
+- a stream reader prefetches a bounded readahead window per run during
+  k-way merges without inflating whole blocks;
+- byte ranges are addressable: a reader seeks straight to frame *i*.
+
+Layout (all integers little-endian)::
+
+    header   b"DTFR" | u8 version (1)
+    frame*   u8 codec_id | u64 raw_len | u64 comp_len | payload
+    footer   pickled {"frames": [(offset, codec_id, raw_len, comp_len,
+                                  records), ...], "records": total}
+    trailer  u64 footer_offset | b"DTFE"
+
+Readers sniff the 4-byte header magic, so these files coexist with
+legacy gzip (``\\x1f\\x8b``) and plain-pickle (``\\x80``) spills in one
+run directory; the trailer magic proves the footer landed — a truncated
+write (crash mid-spill) fails loudly with :class:`FrameFormatError`
+instead of yielding a silently short block.
+"""
+
+import os
+import pickle
+import struct
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from . import codecs
+
+MAGIC = b"DTFR"
+TRAILER_MAGIC = b"DTFE"
+VERSION = 1
+
+_HEADER = struct.Struct("<4sB")
+_FRAME = struct.Struct("<BQQ")
+_TRAILER = struct.Struct("<Q4s")
+
+
+class FrameFormatError(RuntimeError):
+    """Corrupt, truncated, or non-frame file where a frame file was
+    expected."""
+
+
+def is_frame_file(magic4):
+    return magic4[:4] == MAGIC
+
+
+class FrameWriter(object):
+    """Append frames to an open binary file object; ``close()`` writes the
+    index footer + trailer.  One writer per file, single-threaded (the
+    spill pool gives each queued block its own writer)."""
+
+    def __init__(self, f, codec):
+        self.f = f
+        self.codec = codec
+        self.index = []
+        self.records = 0
+        self.raw_bytes = 0
+        f.write(_HEADER.pack(MAGIC, VERSION))
+
+    def add_frame(self, payload, records=0):
+        """Compress and append one frame; returns compressed size."""
+        comp = self.codec.compress(payload)
+        off = self.f.tell()
+        self.f.write(_FRAME.pack(self.codec.cid, len(payload), len(comp)))
+        self.f.write(comp)
+        self.index.append((off, self.codec.cid, len(payload), len(comp),
+                           records))
+        self.records += records
+        self.raw_bytes += len(payload)
+        return len(comp)
+
+    def add_block(self, block, window, at_least_one=False):
+        """Append one block as framed ``window``-record columnar slices —
+        THE slicing every spill writer shares, so save_block files and
+        streamed merge-generation files stay frame-identical."""
+        n = len(block)
+        for at in range(0, max(n, 1) if at_least_one else n, window):
+            end = min(at + window, n)
+            self.add_frame(dump_window_payload(
+                block.keys[at:end], block.values[at:end],
+                None if block.h1 is None else block.h1[at:end],
+                None if block.h2 is None else block.h2[at:end]),
+                records=end - at)
+
+    def close(self):
+        """Write footer + trailer.  The caller owns flushing/fsyncing and
+        closing the underlying file (atomic-rename writers fsync before
+        the rename; plain writers just close)."""
+        footer_off = self.f.tell()
+        self.f.write(pickle.dumps(
+            {"frames": self.index, "records": self.records},
+            protocol=pickle.HIGHEST_PROTOCOL))
+        self.f.write(_TRAILER.pack(footer_off, TRAILER_MAGIC))
+
+
+class FrameReader(object):
+    """Random-access reader over one frame file.  Uses ``os.pread`` so
+    concurrent prefetch tasks share a single fd without seek races."""
+
+    def __init__(self, path, fd=None):
+        """``fd``: adopt an already-open read fd for ``path`` (the caller
+        sniffed the magic from it) instead of opening a second one; the
+        reader owns closing it either way."""
+        self.path = path
+        self._fd = os.open(path, os.O_RDONLY) if fd is None else fd
+        self._closed = False
+        try:
+            size = os.fstat(self._fd).st_size
+            head = os.pread(self._fd, _HEADER.size, 0)
+            if len(head) < _HEADER.size or head[:4] != MAGIC:
+                raise FrameFormatError(
+                    "{}: not a frame spill file".format(path))
+            version = head[4]
+            if version > VERSION:
+                raise FrameFormatError(
+                    "{}: frame format version {} is newer than this "
+                    "reader (max {})".format(path, version, VERSION))
+            if size < _HEADER.size + _TRAILER.size:
+                raise FrameFormatError(
+                    "{}: truncated frame file ({} bytes)".format(path, size))
+            trailer = os.pread(self._fd, _TRAILER.size, size - _TRAILER.size)
+            footer_off, tmagic = _TRAILER.unpack(trailer)
+            if tmagic != TRAILER_MAGIC:
+                raise FrameFormatError(
+                    "{}: missing frame trailer (truncated spill — the "
+                    "writer died before the footer landed)".format(path))
+            flen = size - _TRAILER.size - footer_off
+            if footer_off < _HEADER.size or flen <= 0:
+                raise FrameFormatError(
+                    "{}: frame footer offset {} out of range".format(
+                        path, footer_off))
+            try:
+                footer = pickle.loads(
+                    os.pread(self._fd, flen, footer_off))
+                self.index = footer["frames"]
+                self.records = footer.get("records", 0)
+            except Exception as e:
+                raise FrameFormatError(
+                    "{}: unreadable frame footer ({})".format(path, e))
+        except Exception:
+            os.close(self._fd)
+            self._closed = True
+            raise
+
+    def __len__(self):
+        return len(self.index)
+
+    def read_frame(self, i):
+        """Read + decompress frame ``i`` -> payload bytes.  Thread-safe
+        (pread); raises ``FrameFormatError`` on short reads."""
+        off, cid, raw_len, comp_len, _records = self.index[i]
+        data = os.pread(self._fd, _FRAME.size + comp_len, off)
+        if len(data) < _FRAME.size + comp_len:
+            raise FrameFormatError(
+                "{}: frame {} truncated (indexed {} bytes at {}, file has "
+                "{})".format(self.path, i, comp_len, off, len(data)))
+        hcid, hraw, hcomp = _FRAME.unpack_from(data)
+        if hcid != cid or hcomp != comp_len:
+            raise FrameFormatError(
+                "{}: frame {} header disagrees with the footer "
+                "index".format(self.path, i))
+        # memoryview: no second copy of the payload bytes — for raw
+        # frames (the dominant numeric spill volume) the slice would
+        # otherwise duplicate every byte read; pickle and the codecs all
+        # accept buffers.
+        payload = codecs.decompress(cid, memoryview(data)[_FRAME.size:])
+        if len(payload) != raw_len:
+            raise FrameFormatError(
+                "{}: frame {} inflated to {} bytes, index says {}".format(
+                    self.path, i, len(payload), raw_len))
+        return payload
+
+    def iter_payloads(self, prefetch=0, on_read=None, on_wait=None):
+        """Yield every frame's payload in order.
+
+        ``prefetch > 0`` keeps that many frames in flight on the shared
+        read executor — reads+decompression overlap the consumer, and
+        sibling streams' frames decompress in parallel.  ``on_read(nbytes,
+        seconds)`` fires per frame with the compressed bytes moved and the
+        read+inflate thread-seconds; ``on_wait(seconds)`` fires when the
+        consumer blocked on a not-yet-done prefetch (the read-side
+        ``io_wait``)."""
+        n = len(self.index)
+        if prefetch <= 0 or n <= 1:
+            for i in range(n):
+                t0 = time.perf_counter()
+                payload = self.read_frame(i)
+                if on_read is not None:
+                    on_read(self.index[i][3], time.perf_counter() - t0)
+                yield payload
+            return
+
+        pool = read_executor()
+
+        def task(i):
+            t0 = time.perf_counter()
+            payload = self.read_frame(i)
+            return payload, self.index[i][3], time.perf_counter() - t0
+
+        pending = deque()
+        nxt = 0
+        try:
+            while nxt < min(prefetch, n):
+                pending.append(pool.submit(task, nxt))
+                nxt += 1
+            while pending:
+                fut = pending.popleft()
+                waited = 0.0
+                if not fut.done():
+                    w0 = time.perf_counter()
+                    fut.result()
+                    waited = time.perf_counter() - w0
+                payload, nbytes, secs = fut.result()
+                if on_read is not None:
+                    on_read(nbytes, secs)
+                if on_wait is not None and waited:
+                    on_wait(waited)
+                if nxt < n:
+                    pending.append(pool.submit(task, nxt))
+                    nxt += 1
+                yield payload
+        finally:
+            # Abandoned iterator (a merge that stopped early): wait out the
+            # in-flight reads before closing the fd under them, then drop
+            # the results.
+            for fut in pending:
+                if not fut.cancel():
+                    try:
+                        fut.result()
+                    except Exception:
+                        pass
+            self.close()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
+
+
+#: Shared bounded executor for prefetch reads across every stream (a
+#: k-way merge over hundreds of runs must not spawn hundreds of reader
+#: threads).  Lazy: pipelines that never prefetch never start it.
+_read_pool = None
+_read_pool_lock = threading.Lock()
+
+
+def read_executor():
+    global _read_pool
+    if _read_pool is None:
+        with _read_pool_lock:
+            if _read_pool is None:
+                from .. import settings
+
+                _read_pool = ThreadPoolExecutor(
+                    max_workers=max(1, settings.spill_read_threads),
+                    thread_name_prefix="dampr-io-read")
+    return _read_pool
+
+
+# -- block-level helpers (the spill wire payloads) ---------------------------
+
+def dump_window_payload(keys, values, h1, h2):
+    """One frame payload: the same pickled columnar window tuple the
+    legacy stream format carries, so payloads are format-agnostic."""
+    return pickle.dumps((keys, values, h1, h2),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_window_payload(payload):
+    return pickle.loads(payload)
+
+
+def write_block_frames(block, f, codec, window, at_least_one=False):
+    """Write one block onto ``f`` as framed ``window``-record slices.
+    Returns the FrameWriter (already closed) for its stats."""
+    w = FrameWriter(f, codec)
+    w.add_block(block, window, at_least_one=at_least_one)
+    w.close()
+    return w

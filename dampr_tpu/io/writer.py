@@ -1,0 +1,206 @@
+"""Background spill writer pool: codec + disk off the fold's thread.
+
+Spill writes used to run synchronously on whichever thread tripped the
+memory governor — a fold that evicted a victim paid the victim's full
+compress+write before its next window.  The pool decouples them: victims
+enqueue onto a small writer executor and the submitting thread returns
+immediately, unless the queue is *full* — in-flight bytes are bounded and
+charged against the stage memory budget (the same displacement discipline
+as ``RunStore.reserve_overlap``: queued blocks' RAM is still held, so the
+governor's victim target shrinks by exactly that amount).
+
+Durability and publish order, per write::
+
+    <final>.tmp  ->  write frames  ->  flush + fsync  ->  rename(final)
+    ->  ref.path = final; ref._block = None   (under the store lock)
+
+The ref stays fully readable through its RAM block until the rename has
+landed, so concurrent readers never observe a half-written file, and
+``resume.py`` manifests (written only after ``drain()``) never reference
+a path that could vanish on crash.  A killed run's ``abort()`` discards
+queued writes, releases their budget charges, and leaves no ``.tmp``
+orphans — queued-but-unstarted jobs never touch the filesystem.
+
+Observability: every queued write records a ``spill_queue`` span (enqueue
+-> write start), the write itself a ``spill`` span on the writer thread's
+lane; submitter blocking on a full queue records ``io_wait`` and feeds
+the store's ``io_wait_seconds``.
+"""
+
+import logging
+import os
+import queue
+import threading
+import time
+
+from ..obs import trace as _trace
+from . import frames
+
+log = logging.getLogger("dampr_tpu.io.writer")
+
+_STOP = object()
+
+
+class SpillWriterPool(object):
+    """Bounded writer executor owned by one :class:`~dampr_tpu.storage.
+    RunStore`.  Threads start lazily on first submit and are daemons (an
+    abandoned store — tests, tools — never wedges interpreter exit)."""
+
+    def __init__(self, store, threads, cap_bytes, window):
+        self.store = store
+        self.n_threads = max(1, threads)
+        self.cap_bytes = max(1, cap_bytes)
+        self.window = window
+        self._q = queue.Queue()
+        self._cv = threading.Condition()
+        self._threads = []
+        self.inflight_bytes = 0   # read by the victim selector (atomic read)
+        self.inflight_peak = 0
+        self._outstanding = 0
+        self._error = None
+        self._aborting = False
+
+    # -- submit side --------------------------------------------------------
+    def _ensure_threads(self):
+        # Under the cv lock: concurrent first submits (two fold threads
+        # tripping the governor at once) must not each spawn a worker set.
+        with self._cv:
+            if self._threads:
+                return
+            for i in range(self.n_threads):
+                t = threading.Thread(
+                    target=self._worker, daemon=True,
+                    name="dampr-spill-writer-{}".format(i))
+                t.start()
+                self._threads.append(t)
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def submit(self, ref, block, final_path, codec, clear_block):
+        """Enqueue one block write.  ``block`` is the submitter's snapshot
+        of the ref's data (the worker must not chase ``ref._block``, which
+        a concurrent delete may clear).  ``clear_block=True`` is the spill
+        contract (publish frees the RAM copy); ``False`` is the checkpoint-
+        persist contract (the block stays hot, only ``ref.path`` lands).
+
+        Blocks only while in-flight bytes already sit at the cap — the
+        fold-side ``io_wait``.  Admission is by current backlog, not
+        backlog + this block: a block larger than the cap must still be
+        writable, and sizing the bound as ``cap + one block`` keeps
+        sibling writer threads fed when blocks are cap-sized (the
+        double-buffering this pool exists for).
+
+        The charge is the larger of the ref's host accounting and the
+        snapshot's own bytes: a device-resident ref persisted through
+        the pool (checkpointing) carries metadata-only ``nbytes`` while
+        its just-materialized value lane is the real queued RAM — the
+        charge must bound what actually sits in the queue."""
+        nbytes = max(1, ref.nbytes, block.nbytes())
+        with self._cv:
+            self._raise_pending()
+            w0 = 0.0
+            while (self.inflight_bytes >= self.cap_bytes
+                   and not self._aborting):
+                if not w0:
+                    w0 = time.perf_counter()
+                self._cv.wait(0.05)
+                self._raise_pending()
+            if w0:
+                waited = time.perf_counter() - w0
+                self.store.count_io_wait(waited)
+                _trace.complete("io_wait", "writer-backpressure",
+                                w0, bytes=nbytes)
+            self.inflight_bytes += nbytes
+            self.inflight_peak = max(self.inflight_peak, self.inflight_bytes)
+            self._outstanding += 1
+        self._ensure_threads()
+        self._q.put((ref, block, final_path, codec, clear_block, nbytes,
+                     _trace.now() or time.perf_counter()))
+
+    # -- worker side --------------------------------------------------------
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            ref, block, final, codec, clear_block, nbytes, t_enq = item
+            if self._aborting or ref._dead:
+                # Dead ref (dropped while queued — merge planners drop
+                # just-merged runs routinely): skip the whole codec+
+                # fsync; a publish would only unlink the file anyway.
+                self._settle(nbytes)
+                continue
+            _trace.complete("spill_queue", "queued", t_enq, bytes=nbytes)
+            tmp = final + ".tmp"
+            try:
+                t0 = time.perf_counter()
+                with _trace.span("spill", "spill-write", bytes=nbytes,
+                                 records=len(block)):
+                    with open(tmp, "wb") as f:
+                        frames.write_block_frames(
+                            block, f, codec, self.window, at_least_one=True)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, final)
+                secs = time.perf_counter() - t0
+                try:
+                    disk_bytes = os.path.getsize(final)
+                except OSError:
+                    disk_bytes = 0  # stats only: never fail a landed write
+                self.store.publish_spill(ref, final, nbytes, disk_bytes,
+                                         secs, clear_block=clear_block)
+            except BaseException as e:  # disk full, codec bug: fail the run
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+                log.error("background spill write failed: %s", e)
+            finally:
+                self._settle(nbytes)
+
+    def _settle(self, nbytes):
+        with self._cv:
+            self.inflight_bytes = max(0, self.inflight_bytes - nbytes)
+            self._outstanding -= 1
+            self._cv.notify_all()
+
+    # -- lifecycle ----------------------------------------------------------
+    def drain(self):
+        """Block until every queued write has published; re-raise the
+        first write failure.  The stage-boundary barrier (and the step
+        before any checkpoint manifest lands)."""
+        with self._cv:
+            while self._outstanding > 0:
+                self._cv.wait(0.05)
+            self._raise_pending()
+
+    def abort(self):
+        """Kill-path drain: queued-but-unstarted writes are discarded
+        (those refs keep their RAM blocks and never touched disk); a
+        write a worker already started runs to completion and publishes
+        normally — every ref is left in one consistent state or the
+        other, budget charges are released, and no temp files remain."""
+        self._aborting = True
+        try:
+            with self._cv:
+                while self._outstanding > 0:
+                    self._cv.wait(0.05)
+                self._error = None
+        finally:
+            self._aborting = False
+
+    def close(self):
+        """Stop the worker threads (used by store cleanup; queued writes
+        are aborted first)."""
+        self.abort()
+        for _ in self._threads:
+            self._q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []

@@ -20,8 +20,14 @@ categories (event ``cat``):
 - ``fold`` — map-side partial/final segment folds;
 - ``stall`` — a fold consumer blocked on its producer (the per-slot view
   of devtime's ``codec_wait`` union);
-- ``spill`` / ``hbm`` — budget-pressure block spills; HBM h2d puts and
-  device->host offloads;
+- ``spill`` / ``hbm`` — budget-pressure block spills (on the background
+  writer pool's lanes when ``settings.spill_write_threads`` > 0); HBM
+  h2d puts and device->host offloads;
+- ``spill_queue`` — a queued write's enqueue->write-start latency on its
+  writer lane (how long the spill sat behind the pool's backlog);
+- ``io_wait`` — a fold/register thread blocked on writer-pool
+  backpressure (``writer-backpressure``), or a merge/final-read consumer
+  outrunning its frame prefetch (``read-wait``);
 - ``merge`` — spill-lean merge generations, streamed merge runs, k-way
   read rounds, compaction markers;
 - ``collective`` — mesh keyed folds, byte exchanges, global sums;
@@ -45,6 +51,13 @@ returned in-memory from every run — traced or not — via
   (epoch/delta snapshots of :mod:`dampr_tpu.ops.devtime`);
 - ``overlap`` — configured windows, ``stall_fraction`` (codec_wait /
   wall: the codec time still on the critical path), peak in-flight bytes;
+- ``io`` — the async spill subsystem's shape: ``spill_write_bytes/
+  seconds/mbps`` (post-codec disk bandwidth, writer-pool thread-seconds),
+  ``spill_read_bytes/seconds/mbps`` (frame reads + inflate),
+  ``io_wait_seconds/fraction`` (total) and ``io_wait_write_seconds/
+  fraction`` (fold-side writer backpressure only — the stall the pool
+  exists to eliminate), ``writer_threads``, ``read_prefetch``,
+  ``inflight_peak_bytes``;
 - ``store`` — spill/merge/HBM-tier totals; ``mesh`` — collective fold/
   exchange counts and bytes; ``retries``; ``totals``;
 - ``trace_file`` / ``stats_file`` — artifact paths (None untraced).

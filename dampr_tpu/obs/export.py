@@ -148,6 +148,16 @@ def format_summary(summary):
     add("spill: {} blocks / {}  ·  merge generations: {} ({})".format(
         store.get("spill_count", 0), _mb(store.get("spilled_bytes", 0)),
         store.get("merge_gens", 0), _mb(store.get("merge_gen_bytes", 0))))
+    io = summary.get("io", {})
+    if io.get("spill_write_bytes") or io.get("spill_read_bytes"):
+        add("spill io: wrote {} @ {:.0f} MB/s · read {} @ {:.0f} MB/s · "
+            "io_wait {:.2f}s ({:.1%} of wall)".format(
+                _mb(io.get("spill_write_bytes", 0)),
+                io.get("spill_write_mbps", 0.0),
+                _mb(io.get("spill_read_bytes", 0)),
+                io.get("spill_read_mbps", 0.0),
+                io.get("io_wait_seconds", 0.0),
+                io.get("io_wait_fraction", 0.0)))
     if store.get("h2d_bytes") or store.get("hbm_offloads"):
         add("HBM tier: {} up, {} fetched back, {} offloads, peak {}".format(
             _mb(store.get("h2d_bytes", 0)), _mb(store.get("d2h_bytes", 0)),

@@ -397,12 +397,19 @@ def _manifest_path(root, sid):
     return os.path.join(_manifest_dir(root), "stage_{}.json".format(sid))
 
 
-def _ensure_on_disk(ref, directory):
-    """Return a durable file path holding this ref's block, writing one if
-    the block only lives in RAM.  Resident blocks KEEP their RAM copy (the
-    next stage reads hot); BlockRef.spill() skips rewriting refs that
-    already have a path, so persisted blocks spill for free later."""
-    from .storage import save_block
+def _ensure_on_disk(ref, directory, pool=None):
+    """Return a durable file path holding this ref's block, scheduling a
+    write if the block only lives in RAM.  Resident blocks KEEP their RAM
+    copy (the next stage reads hot); BlockRef.spill() skips rewriting refs
+    that already have a path, so persisted blocks spill for free later.
+
+    With ``pool`` (the store's background spill writer) the write enqueues
+    — checkpoint persistence of a wide stage runs its codec+disk across
+    the writer threads — and the returned path is the write's target;
+    the caller MUST ``drain_writes()`` before referencing it in a
+    manifest (fsync + rename happen inside the pool, so a drained
+    manifest never points at a half-written file)."""
+    from .storage import _spill_codec, save_block
 
     if ref.pin:
         os.makedirs(directory, exist_ok=True)
@@ -418,8 +425,13 @@ def _ensure_on_disk(ref, directory):
         # HBM-resident refs materialize via one counted value-lane fetch
         # (their device copy stays live for the consuming reduce).
         blk = ref.get()
-        save_block(blk, path)
-        ref.path = path
+        if pool is not None:
+            pool.submit(ref, blk, path,
+                        _spill_codec(ref.key_dtype, ref.value_dtype),
+                        clear_block=False)
+        else:
+            save_block(blk, path)
+            ref.path = path
         return path, blk.nbytes()
     return ref.path, ref.nbytes
 
@@ -443,12 +455,19 @@ def persist_stage(store, sid, fp, result, nrec):
     elif isinstance(result, PartitionSet):
         directory = os.path.join(root, "ckpt", "stage_{}".format(sid))
         blocks = []
+        # Unwritten blocks fan out across the store's background writer
+        # pool; the drain below is the durability barrier — the manifest
+        # lands only after every referenced file has been fsync'd and
+        # renamed into place, so a crash between the two leaves a
+        # restorable previous manifest, never a dangling one.
+        pool = store.writer_pool()
         for pid in sorted(result.parts):
             for ref in result.parts[pid]:
-                path, nbytes = _ensure_on_disk(ref, directory)
+                path, nbytes = _ensure_on_disk(ref, directory, pool)
                 blocks.append([pid, os.path.relpath(path, root),
                                ref.nrecords, int(nbytes),
                                str(ref.key_dtype), str(ref.value_dtype)])
+        store.drain_writes()
         manifest = {"fp": fp, "kind": "pset",
                     "n_partitions": result.n_partitions,
                     "blocks": blocks, "nrec": nrec,

@@ -511,7 +511,12 @@ class OutputDataset(Dataset):
             flush()
             # The bucket runs ARE the sorted materialization: cache them so
             # repeated reads reuse one exchange, and release them (only) in
-            # delete() — abandoned read iterators cannot leak refs.
+            # delete() — abandoned read iterators cannot leak refs.  This
+            # build may run AFTER the stage walk (lazy post-run reads), so
+            # settle any spills its registrations queued: no other barrier
+            # will run for them.
+            if self.store is not None:
+                self.store.drain_writes()
             self._range_cache = bucket_refs
 
         def gen():
@@ -852,12 +857,15 @@ class MTRunner(object):
     def _effective_merge_fanin(self, runs):
         """Fan-in cap for the sorted-run merge: the configured
         ``settings.merge_fanin``, clamped so the k-way merge's working set
-        (one spill window per run, sized from the runs' observed
-        bytes/record) stays inside half the stage budget."""
+        — one buffered spill window per run PLUS that run's bounded frame
+        readahead (``settings.spill_read_prefetch`` windows in flight on
+        the read executor), sized from the runs' observed bytes/record —
+        stays inside the stage budget."""
         total = sum(max(1, r.total_bytes) for r in runs)
         nrec = sum(len(r) for r in runs)
         window = max(1, int(total / max(1, nrec)) * storage.SPILL_WINDOW)
-        cap = max(4, int(self.store.budget // (2 * window)))
+        per_run = (2 + max(0, settings.spill_read_prefetch)) * window
+        cap = max(4, int(self.store.budget // per_run))
         return max(2, min(settings.merge_fanin, cap))
 
     def _plan_sorted_merge(self, pset):
@@ -865,10 +873,23 @@ class MTRunner(object):
         sort).  When the number of first-level runs fits the fan-in cap,
         nothing happens — the final read merges the runs directly, so the
         only bytes that ever hit disk are the map jobs' single spill
-        generation.  Past the cap, runs merge in generations of ``fanin``
-        through a streamed file->file pass (one in-flight window per
-        source, output written as it merges — never RAM-resident whole)
-        until the count fits."""
+        generation.  Past the cap, a generation merges runs through
+        streamed file->file passes (one in-flight window per source,
+        output written as it merges — never RAM-resident whole) until the
+        count fits, with two spill-lean refinements:
+
+        - **minimal-touch planning**: only enough runs merge to bring the
+          count under the cap — the smallest ones, so a run set just past
+          the fan-in re-spills a fraction of its bytes, not all of them
+          (merging m groups of <= g runs cuts the count by sum(g_i - 1),
+          so m = ceil(excess / (g - 1)) merges suffice and everything
+          else feeds the final read untouched);
+        - **parallel generations**: the groups are independent, so they
+          merge concurrently on a worker pool, each group's fan-in share
+          capped at ``fanin // workers`` — the combined working set (one
+          buffered window + prefetch per source across every concurrent
+          merge) stays inside what the fan-in clamp budgeted.
+        """
         from .blocks import merge_sorted_streams
 
         runs = [r for r in pset.all_refs() if len(r)]
@@ -877,25 +898,59 @@ class MTRunner(object):
         fanin = self._effective_merge_fanin(runs)
         gen = 0
         while len(runs) > fanin:
-            log.info("sorted-run merge generation: %d runs over fan-in %d",
-                     len(runs), fanin)
-            nxt = []
-            # Each generation gets its own trace lane, so Perfetto shows
-            # merge generations stacked under the map slots they follow.
+            # Worker count divides the fan-in budget: workers * group_cap
+            # <= fanin, so the concurrent merges' combined working set
+            # (one buffered window + prefetch per source) never exceeds
+            # what _effective_merge_fanin budgeted — and group_cap >= 2
+            # always (fanin >= 2), so every group genuinely reduces the
+            # run count.
+            workers = max(1, min(settings.max_processes, 8, fanin // 2))
+            group_cap = max(2, fanin // workers)
+            need = len(runs) - fanin
+            m = max(1, -(-need // (group_cap - 1)))
+            touched = need + m
+            if touched > len(runs):
+                # Far over the cap: every run merges this generation, in
+                # groups of group_cap (the count still shrinks by a
+                # group_cap factor per generation; the loop reruns).
+                touched = len(runs)
+                m = -(-touched // group_cap)
+            # Smallest runs merge (fewest re-spilled bytes); the stride
+            # split balances group sizes AND bytes across the workers.
+            runs.sort(key=lambda r: r.total_bytes)
+            to_merge = runs[:touched]
+            keep = runs[touched:]
+            groups = [g for g in (to_merge[i::m] for i in range(m)) if g]
+            log.info(
+                "sorted-run merge generation: %d runs over fan-in %d — "
+                "merging %d smallest into %d group(s) on %d worker(s)",
+                len(runs), fanin, touched, len(groups),
+                min(workers, len(groups)))
+
+            def merge_group(group):
+                if len(group) == 1:
+                    return group[0]
+                merged = self.store.register_stream(merge_sorted_streams(
+                    [r.iter_windows() for r in group]))
+                for r in group:
+                    self.store.drop_ref(r)
+                return merged
+
+            # The generation gets its own trace lane, so Perfetto shows
+            # merge generations stacked under the map slots they follow;
+            # each group's streamed merge-run span lands on its worker
+            # thread's lane.
             with _trace.span("merge", "generation {}".format(gen),
                              lane="merge gen {}".format(gen),
-                             runs=len(runs), fanin=fanin):
-                for at in range(0, len(runs), fanin):
-                    group = runs[at:at + fanin]
-                    if len(group) == 1:
-                        nxt.append(group[0])
-                        continue
-                    merged = self.store.register_stream(merge_sorted_streams(
-                        [r.iter_windows() for r in group]))
-                    for r in group:
-                        self.store.drop_ref(r)
-                    nxt.append(merged)
-            runs = nxt
+                             runs=len(runs), fanin=fanin,
+                             groups=len(groups)):
+                if len(groups) > 1 and workers > 1:
+                    with ThreadPoolExecutor(
+                            max_workers=min(workers, len(groups))) as pool:
+                        merged = list(pool.map(merge_group, groups))
+                else:
+                    merged = [merge_group(g) for g in groups]
+            runs = keep + merged
             gen += 1
         pset.parts = {0: runs}
 
@@ -2031,6 +2086,38 @@ class MTRunner(object):
                                          4) if wall > 0 else 0.0),
                 "peak_bytes": sto.overlap_peak_bytes,
             },
+            # Spill I/O shape (dampr_tpu.io): post-codec disk bandwidth on
+            # both sides plus the fold-side stall on writer backpressure /
+            # not-yet-prefetched frames — the numbers the async spill
+            # subsystem moves (seconds are thread-seconds on the writer/
+            # reader pools; io_wait_fraction is against run wall time).
+            "io": {
+                "spill_write_bytes": sto.spill_disk_bytes,
+                "spill_write_seconds": round(sto.spill_write_seconds, 4),
+                "spill_write_mbps": (
+                    round(sto.spill_disk_bytes / 1e6
+                          / sto.spill_write_seconds, 2)
+                    if sto.spill_write_seconds > 1e-9 else 0.0),
+                "spill_read_bytes": sto.spill_read_bytes,
+                "spill_read_seconds": round(sto.spill_read_seconds, 4),
+                "spill_read_mbps": (
+                    round(sto.spill_read_bytes / 1e6
+                          / sto.spill_read_seconds, 2)
+                    if sto.spill_read_seconds > 1e-9 else 0.0),
+                "io_wait_seconds": round(sto.io_wait_seconds, 4),
+                "io_wait_fraction": (round(sto.io_wait_seconds / wall, 4)
+                                     if wall > 0 else 0.0),
+                # fold-side only (writer backpressure): the stall the
+                # background writer pool exists to eliminate; read-side
+                # prefetch waits are the difference to the totals above.
+                "io_wait_write_seconds": round(sto.io_wait_write_seconds, 4),
+                "io_wait_write_fraction": (
+                    round(sto.io_wait_write_seconds / wall, 4)
+                    if wall > 0 else 0.0),
+                "writer_threads": settings.spill_write_threads,
+                "read_prefetch": settings.spill_read_prefetch,
+                "inflight_peak_bytes": sto.spill_inflight_peak_bytes,
+            },
             "store": {
                 "budget": sto.budget,
                 "spill_count": sto.spill_count,
@@ -2082,6 +2169,15 @@ class MTRunner(object):
                 _resume.gc_unreferenced(self.store.root)
             guard.share()
             return self._run_stages(outputs, cleanup)
+        except BaseException:
+            # Drain-on-kill: a failing/killed run discards its queued
+            # background spill writes (refs keep their RAM blocks; no
+            # temp files survive) instead of racing them against teardown.
+            try:
+                self.store.abort_writes()
+            except Exception:
+                log.warning("spill writer abort failed", exc_info=True)
+            raise
         finally:
             guard.close()
 
@@ -2263,6 +2359,12 @@ class MTRunner(object):
                 raise TypeError("Unknown stage type: {!r}".format(stage))
 
             env[stage.output] = result
+            # Stage-boundary write barrier: every spill this stage's
+            # registration pressure queued publishes now, so per-stage
+            # spill attribution stays causal and checkpoint persistence
+            # below sees settled refs (a ref mid-write has no path yet
+            # and would be pointlessly re-written).
+            self.store.drain_writes()
             if self.resume:
                 _resume.persist_stage(
                     self.store, sid, stage_fps[sid], result, nrec)
@@ -2277,6 +2379,11 @@ class MTRunner(object):
             _trace.complete("stage", "s{}:{}".format(sid, kind), t0_span,
                             lane="stages", records=nrec, jobs=njobs)
             log.info("Stage %s done: %s", sid + 1, st.as_dict())
+
+        # Final write barrier: OutputDataset readers and post-run tools see
+        # every spill published (per-stage drains cover the loop; this
+        # backstops runs whose last stage raised between drain points).
+        self.store.drain_writes()
 
         sto = self.store
         if sto.h2d_bytes or sto.d2h_bytes or sto.hbm_offloads:

@@ -256,10 +256,49 @@ merge_fanin = int(os.environ.get("DAMPR_TPU_MERGE_FANIN", "512"))
 def sort_runs_enabled():
     return str(sort_runs).lower() not in ("off", "0", "false")
 
-#: Spill compression policy: "auto" (default) gzips object-lane blocks and
-#: writes fully-numeric blocks plain (high-entropy lanes don't compress and
-#: the gzip pass is core-bound both ways); "always"/"never" force it.
+#: Spill compression policy: "auto" (default) compresses object-lane
+#: blocks and writes fully-numeric blocks raw (high-entropy lanes don't
+#: compress and the codec pass is core-bound both ways); "always"/"never"
+#: force it.  A codec name ("gzip", "zlib", "zlib:6", "lz4", "zstd") is
+#: also accepted and means "always, with that codec".
 spill_compress = os.environ.get("DAMPR_TPU_SPILL_COMPRESS", "auto")
+
+#: Frame codec used when the policy above says compress: "auto" picks the
+#: best available (zstd > lz4 > zlib); explicit names take an optional
+#: ":level" suffix ("zlib:6").  Unavailable optional codecs (lz4/zstd not
+#: installed) fall back down the same ladder with a one-time warning;
+#: gzip remains readable forever via per-frame codec ids and whole-file
+#: magic sniffing (see dampr_tpu.io and docs/spill_format.md).
+spill_codec = os.environ.get("DAMPR_TPU_SPILL_CODEC", "auto")
+
+#: Background spill writer threads (dampr_tpu.io.writer.SpillWriterPool):
+#: spill writes enqueue onto this many writer threads so folds never
+#: block on codec+disk unless the queue is full; queued blocks'
+#: in-flight bytes are charged against the stage memory budget like
+#: overlap windows.  0 = synchronous spills on the evicting thread (the
+#: pre-PR-3 behavior).
+spill_write_threads = int(os.environ.get("DAMPR_TPU_SPILL_WRITERS", "2"))
+
+#: Byte cap on queued-but-unwritten spill blocks (the writer pool's
+#: double-buffering bound; admission is by current backlog, so in-flight
+#: bytes peak at this cap plus one block).  None or 0 = half the stage
+#: memory budget.  Queued bytes are budget-charged either way — they
+#: displace resident blocks, never stack on top of the stage ceiling.
+spill_inflight_bytes = (int(os.environ["DAMPR_TPU_SPILL_INFLIGHT"])
+                        if os.environ.get("DAMPR_TPU_SPILL_INFLIGHT")
+                        else None)
+
+#: Readahead depth (frames) per spilled-run stream: merge readers and
+#: final reads keep this many frames in flight on the shared read
+#: executor, so decompression overlaps consumption and sibling runs'
+#: frames decode in parallel.  0 = strictly serial reads.
+spill_read_prefetch = int(os.environ.get("DAMPR_TPU_SPILL_PREFETCH", "2"))
+
+#: Threads on the shared frame-read executor (process-wide; a k-way merge
+#: over hundreds of runs multiplexes its prefetch onto these).
+spill_read_threads = int(os.environ.get(
+    "DAMPR_TPU_SPILL_READ_THREADS",
+    str(min(4, multiprocessing.cpu_count()))))
 
 #: Spill directory for host-RAM overflow (the reference's /tmp/<job> scratch tree,
 #: base.py:435-469).
@@ -286,7 +325,8 @@ profile_dir = os.environ.get("DAMPR_TPU_PROFILE_DIR") or None
 #: a single None-check each, so the engine's hot loops pay near-zero cost.
 #: This is the engine-boundary timeline; ``profile_dir`` above remains the
 #: escape hatch for a profiler-grade XLA kernel timeline.
-trace = os.environ.get("DAMPR_TPU_TRACE", "0") not in ("0", "false", "")
+trace = os.environ.get("DAMPR_TPU_TRACE", "0").lower() not in (
+    "0", "false", "no", "off", "")
 
 #: Override directory for trace/stats artifacts.  None (default) puts them
 #: under the run's scratch root, next to its durable spill/checkpoint

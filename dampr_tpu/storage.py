@@ -12,6 +12,14 @@ offload is the first spill step, gzip'd pickle files on disk the second.
 Every stage output lives behind :class:`BlockRef`; the per-run
 :class:`RunStore` decides which refs stay hot.  ``pin=True`` refs (``cached()``
 stages) never spill.
+
+Spill I/O rides :mod:`dampr_tpu.io`: blocks spill as chunked-frame files
+(independently compressed length-prefixed frames + an index footer —
+parallel decompress, streamable partial reads) through a background
+writer pool whose in-flight bytes are charged against the stage budget,
+and spilled runs read back through a prefetching frame reader.  Pre-frame
+spills (whole-file gzip / plain pickle streams) remain readable via magic
+sniffing.
 """
 
 import contextlib
@@ -21,16 +29,29 @@ import os
 import pickle
 import shutil
 import threading
+import time
 import uuid
 
 import numpy as np
 
 from . import settings
+from .io import codecs as _codecs
+from .io import frames as _frames
+from .io.writer import SpillWriterPool
 from .obs import trace as _trace
 
 log = logging.getLogger("dampr_tpu.storage")
 
 _I32_MAX = 2 ** 31 - 1
+
+_warned_spill_modes = set()  # one warning per unrecognized policy string
+
+
+def _file_size(path):
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
 
 
 class BlockRef(object):
@@ -50,10 +71,12 @@ class BlockRef(object):
 
     __slots__ = ("_block", "_packed", "path", "nbytes", "nrecords",
                  "value_dtype", "key_dtype", "store", "pin",
-                 "_dev", "_kmeta", "dev_bytes", "lane_abs", "lane_min")
+                 "_dev", "_kmeta", "dev_bytes", "lane_abs", "lane_min",
+                 "_dead")
 
     def __init__(self, block, store=None, pin=False, device_prep=None):
         self._packed = None
+        self._dead = False
         self.nrecords = len(block)
         self.value_dtype = block.values.dtype  # metadata survives spilling
         self.key_dtype = block.keys.dtype
@@ -196,6 +219,7 @@ class BlockRef(object):
         ref.dev_bytes = 0
         ref.lane_abs = None
         ref.lane_min = None
+        ref._dead = False
         return ref
 
     def __len__(self):
@@ -239,7 +263,7 @@ class BlockRef(object):
                 return blk
             if self._packed is not None:
                 return unpack_block(self._packed)
-            blk = load_block(self.path)
+            blk = load_block(self.path, self.store)
             # Do not re-cache: reduce jobs stream partitions one at a time and
             # re-residency would defeat the memory bound.
         return blk
@@ -257,7 +281,7 @@ class BlockRef(object):
                 # consistent snapshot.
                 blk = self.get()
             else:
-                for w in iter_block_windows(self.path):
+                for w in iter_block_windows(self.path, self.store):
                     yield w
                 return
         from .blocks import Block
@@ -275,8 +299,16 @@ class BlockRef(object):
             return 0
         if self.path is None:
             os.makedirs(directory, exist_ok=True)
-            self.path = os.path.join(directory, uuid.uuid4().hex + ".blk")
-            save_block(self._block, self.path)
+            path = os.path.join(directory, uuid.uuid4().hex + ".blk")
+            t0 = time.perf_counter()
+            save_block(self._block, path)
+            secs = time.perf_counter() - t0
+            self.path = path
+            # The synchronous path feeds the same io bandwidth counters
+            # as the writer pool, so spill_write_mbps stays comparable
+            # with DAMPR_TPU_SPILL_WRITERS=0 (the async-off baseline).
+            if self.store is not None:
+                self.store.count_spill_write(_file_size(path), secs)
         # else: already durable on disk (checkpoint/resume persisted it) —
         # dropping the RAM copy is the whole spill.
         freed = self.nbytes
@@ -284,6 +316,20 @@ class BlockRef(object):
         return freed
 
     def delete(self):
+        # Serialized against the background writer's publish (both take
+        # the store lock): either the publish lands first and this delete
+        # unlinks the published file, or the ``_dead`` flag lands first
+        # and the publish unlinks its own write — a dropped ref can never
+        # leak a freshly spilled file either way.
+        store = self.store
+        if store is not None:
+            with store._lock:
+                self._delete_inner()
+        else:
+            self._delete_inner()
+
+    def _delete_inner(self):
+        self._dead = True
         self._block = None
         self._packed = None
         self._dev = None
@@ -299,55 +345,58 @@ class BlockRef(object):
 SPILL_WINDOW = 16384
 
 
-def _spill_plain(key_dtype, value_dtype):
+def _spill_codec(key_dtype, value_dtype):
     """Compression policy, shared by every spill writer: numeric columns
-    (hashes, parsed numbers, counts) are mostly high-entropy, so gzip buys
-    little and costs a core-bound pass each way — they spill uncompressed
-    at disk bandwidth; object lanes compress.  ``settings.spill_compress``
-    = "always"/"never" overrides the heuristic."""
+    (hashes, parsed numbers, counts) are mostly high-entropy, so a codec
+    buys little and costs a core-bound pass each way — they spill as raw
+    frames at disk bandwidth; object lanes compress with the configured
+    codec (``settings.spill_codec``).  ``settings.spill_compress`` =
+    "always"/"never" overrides the heuristic, and a codec name there
+    ("zstd", "zlib:6", ...) means always-compress with that codec."""
     mode = str(settings.spill_compress).lower()
-    numeric = key_dtype != object and value_dtype != object
-    return mode == "never" or (mode not in ("always", "1", "true")
-                               and numeric)
-
-
-def _dump_windows(block, f, at_least_one=False):
-    """Write one block onto an open spill stream as pickled columnar
-    SPILL_WINDOW slices — THE wire format ``iter_block_windows`` reads."""
-    n = len(block)
-    for at in range(0, max(n, 1) if at_least_one else n, SPILL_WINDOW):
-        end = min(at + SPILL_WINDOW, n)
-        pickle.dump(
-            (block.keys[at:end], block.values[at:end],
-             None if block.h1 is None else block.h1[at:end],
-             None if block.h2 is None else block.h2[at:end]),
-            f, protocol=pickle.HIGHEST_PROTOCOL)
+    if mode in ("never", "0", "false", "none", "raw"):
+        return _codecs.resolve("raw")
+    if mode in ("always", "1", "true"):
+        return _codecs.resolve(settings.spill_codec,
+                               settings.compress_level)
+    if mode != "auto":
+        try:
+            return _codecs.resolve(mode, settings.compress_level)
+        except ValueError:
+            # Tolerate unrecognized policy strings the way the old
+            # boolean heuristic did ("on", "yes", ... behaved as auto):
+            # a config typo must not fail the run at its first spill.
+            if mode not in _warned_spill_modes:
+                _warned_spill_modes.add(mode)
+                log.warning("unrecognized settings.spill_compress %r; "
+                            "using the 'auto' policy", mode)
+    if key_dtype != object and value_dtype != object:
+        return _codecs.resolve("raw")
+    return _codecs.resolve(settings.spill_codec,
+                           settings.compress_level)
 
 
 def save_block(block, path):
-    """Spill wire format: a sequence of pickled columnar windows, inside one
-    gzip stream for object-lane blocks or as a plain stream for fully
-    numeric ones (``_spill_plain``; readers sniff the gzip magic, so both
-    formats coexist).  Windowing keeps spilled blocks *streamable* — merge
-    readers hold one window per run — while numeric lanes serialize as raw
-    buffers (pickle protocol 5)."""
-    plain = _spill_plain(block.keys.dtype, block.values.dtype)
-    opener = (lambda: open(path, "wb")) if plain else (
-        lambda: gzip.open(path, "wb",
-                          compresslevel=settings.compress_level))
-    with opener() as f:
-        _dump_windows(block, f, at_least_one=True)
+    """Spill wire format (dampr_tpu.io.frames): pickled columnar
+    SPILL_WINDOW slices, each an independently compressed length-prefixed
+    frame, with an index footer — frames decompress in parallel and merge
+    readers stream partial ranges instead of inflating whole blocks.
+    Readers sniff the magic, so these coexist with pre-frame gzip/plain
+    spills (``iter_block_windows`` reads all three)."""
+    codec = _spill_codec(block.keys.dtype, block.values.dtype)
+    with open(path, "wb") as f:
+        _frames.write_block_frames(block, f, codec, SPILL_WINDOW,
+                                   at_least_one=True)
 
 
-def iter_block_windows(path):
-    """Stream a spilled block back window by window (bounded memory).
-    Sniffs the gzip magic so compressed and plain spills coexist."""
+def _iter_legacy_windows(path, magic):
+    """Pre-frame spill formats: a pickle-window stream, whole-file gzip'd
+    for object-lane blocks (sniffed).  Kept verbatim so spill dirs and
+    checkpoint manifests written before the frame format still load."""
     from .blocks import Block
 
     with open(path, "rb") as raw:
-        magic = raw.read(2)
-        raw.seek(0)
-        f = gzip.GzipFile(fileobj=raw) if magic == b"\x1f\x8b" else raw
+        f = gzip.GzipFile(fileobj=raw) if magic[:2] == b"\x1f\x8b" else raw
         while True:
             try:
                 keys, values, h1, h2 = pickle.load(f)
@@ -356,10 +405,56 @@ def iter_block_windows(path):
             yield Block(keys, values, h1, h2)
 
 
-def load_block(path):
+def iter_block_windows(path, store=None):
+    """Stream a spilled block back window by window (bounded memory).
+    Sniffs the leading magic: frame files get the prefetching frame
+    reader (``settings.spill_read_prefetch`` frames in flight on the
+    shared read executor); legacy gzip / plain pickle streams read
+    serially.  ``store`` (when given) accrues read-bandwidth and
+    ``io_wait`` accounting."""
     from .blocks import Block
 
-    return Block.concat(list(iter_block_windows(path)))
+    # One open serves both the magic sniff and the frame reader (the fd
+    # is adopted); only the legacy formats re-open through the buffered
+    # stream readers.
+    fd = os.open(path, os.O_RDONLY)
+    magic = os.pread(fd, 4, 0)
+    if not _frames.is_frame_file(magic):
+        os.close(fd)
+        for w in _iter_legacy_windows(path, magic):
+            yield w
+        return
+
+    on_read = on_wait = None
+    if store is not None:
+        on_read = store.count_spill_read
+
+        def on_wait(secs):
+            store.count_io_wait(secs, read=True)
+            if _trace.enabled():
+                _trace.complete("io_wait", "read-wait",
+                                time.perf_counter() - secs)
+
+    reader = _frames.FrameReader(path, fd=fd)
+    payloads = reader.iter_payloads(
+        settings.spill_read_prefetch, on_read, on_wait)
+    try:
+        for payload in payloads:
+            keys, values, h1, h2 = _frames.load_window_payload(payload)
+            yield Block(keys, values, h1, h2)
+    finally:
+        # Close the payload generator FIRST: its own finally waits out
+        # in-flight prefetch reads before the fd goes away (closing the
+        # fd under a live pread could hit EBADF — or a recycled fd
+        # number).  The direct close is the sequential-branch backstop.
+        payloads.close()
+        reader.close()
+
+
+def load_block(path, store=None):
+    from .blocks import Block
+
+    return Block.concat(list(iter_block_windows(path, store)))
 
 
 def pack_block(block):
@@ -425,10 +520,117 @@ class RunStore(object):
         # merge planner ever pays, and only past the merge_fanin cap.
         self.merge_gen_bytes = 0
         self.merge_gens = 0
+        # Spill I/O shape (dampr_tpu.io): post-codec bytes/seconds moved
+        # by spill writes and frame reads, plus the fold-side seconds
+        # spent blocked on the writer pool's backpressure or a
+        # not-yet-prefetched frame — the ``io`` section of the run stats.
+        self.spill_disk_bytes = 0
+        self.spill_write_seconds = 0.0
+        self.spill_read_bytes = 0
+        self.spill_read_seconds = 0.0
+        self.io_wait_seconds = 0.0        # total: write + read side
+        self.io_wait_write_seconds = 0.0  # fold-side writer backpressure
+        self._writer = None          # lazy SpillWriterPool
 
     def count_d2h(self, n):
         with self._lock:
             self.d2h_bytes += n
+
+    def count_spill_read(self, nbytes, secs):
+        with self._lock:
+            self.spill_read_bytes += nbytes
+            self.spill_read_seconds += secs
+
+    def count_spill_write(self, disk_bytes, secs):
+        """One accounting point for every spill writer — sync spills,
+        streamed merge generations, and the background pool all feed the
+        same bandwidth counters, so their MB/s stay comparable."""
+        with self._lock:
+            self.spill_disk_bytes += disk_bytes
+            self.spill_write_seconds += secs
+
+    def count_io_wait(self, secs, read=False):
+        """``read=False`` is the fold-side stall (a register/fold thread
+        blocked on writer-pool backpressure — the number the async
+        subsystem exists to keep near zero); ``read=True`` is a merge or
+        final-read consumer outrunning its frame prefetch."""
+        with self._lock:
+            self.io_wait_seconds += secs
+            if not read:
+                self.io_wait_write_seconds += secs
+
+    # -- background writer pool ---------------------------------------------
+    @property
+    def spill_inflight_bytes(self):
+        w = self._writer
+        return 0 if w is None else w.inflight_bytes
+
+    @property
+    def spill_inflight_peak_bytes(self):
+        w = self._writer
+        return 0 if w is None else w.inflight_peak
+
+    def writer_pool(self):
+        """The store's background spill writer, or None when disabled
+        (``settings.spill_write_threads = 0`` keeps the synchronous
+        pre-frame behavior)."""
+        if settings.spill_write_threads <= 0:
+            return None
+        if self._writer is None:
+            with self._lock:
+                if self._writer is None:
+                    cap = settings.spill_inflight_bytes
+                    if not cap or cap <= 0:
+                        # None/0/negative all mean "default": a 0 from
+                        # the env must not become a 1-byte cap that
+                        # serializes every spill.
+                        cap = max(self.budget // 2, 1 << 22)
+                    self._writer = SpillWriterPool(
+                        self, settings.spill_write_threads, cap,
+                        SPILL_WINDOW)
+        return self._writer
+
+    def publish_spill(self, ref, path, freed_ram, disk_bytes, secs,
+                      clear_block=True):
+        """Background-write completion: the file is durable (fsync +
+        rename done), so land ``path`` and — for true spills — free the
+        RAM copy.  Publish order matches the synchronous ``spill()``:
+        ``path`` becomes visible before ``_block`` clears, so a reader
+        passing the residency check never loses both tiers."""
+        unlink = False
+        with self._lock:
+            if ref._dead:
+                unlink = True
+            else:
+                ref.path = path
+                if clear_block:
+                    ref._block = None
+                    # Counted only for live refs: a raced delete already
+                    # freed this RAM itself — charging it here too would
+                    # over-report spill volume (the sync path never
+                    # counted deleted refs either).
+                    self.spill_count += 1
+                    self.spilled_bytes += freed_ram
+        self.count_spill_write(disk_bytes, secs)
+        if unlink:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def drain_writes(self):
+        """Barrier: every queued spill/persist write has published.  Ran
+        at stage boundaries (per-stage spill attribution stays causal) and
+        before checkpoint manifests reference spill files."""
+        if self._writer is not None:
+            self._writer.drain()
+
+    def abort_writes(self):
+        """Kill-path drain: queued-but-unstarted writes are discarded
+        (refs keep their RAM blocks); in-flight writes finish and publish.
+        Budget charges released, no temp files left."""
+        if self._writer is not None:
+            self._writer.abort()
 
     # -- overlap (pipelined map driver) accounting --------------------------
     @property
@@ -522,50 +724,68 @@ class RunStore(object):
         directory = os.path.join(self.root, self._stage)
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, uuid.uuid4().hex + ".blk")
-        raw = f = None
+        raw = fw = None
         total_records = 0
         total_bytes = 0
+        write_secs = 0.0
         key_dtype = value_dtype = np.dtype(object)
         t0 = _trace.now()
         try:
             for blk in blocks:
                 if not len(blk):
                     continue
-                if f is None:
+                if fw is None:
                     key_dtype = blk.keys.dtype
                     value_dtype = blk.values.dtype
                     raw = open(path, "wb")
-                    f = raw if _spill_plain(key_dtype, value_dtype) else \
-                        gzip.GzipFile(fileobj=raw, mode="wb",
-                                      compresslevel=settings.compress_level)
-                _dump_windows(blk, f)
+                    fw = _frames.FrameWriter(
+                        raw, _spill_codec(key_dtype, value_dtype))
+                # Frame granularity = the spill window, regardless of the
+                # (possibly multi-window) merged-round block size, so the
+                # read side's one-window-per-run memory bound holds.
+                w0 = time.perf_counter()
+                fw.add_block(blk, SPILL_WINDOW)
+                write_secs += time.perf_counter() - w0
                 total_records += len(blk)
                 total_bytes += blk.nbytes()
         except BaseException:
             # A failed generation (disk full, merge-source read error)
             # must not leak the fd or strand a partial .blk no ref owns.
-            for h in (f, raw):
-                if h is not None:
-                    try:
-                        h.close()
-                    except OSError:
-                        pass
             if raw is not None:
+                try:
+                    raw.close()
+                except OSError:
+                    pass
                 try:
                     os.unlink(path)
                 except OSError:
                     pass
             raise
         else:
-            if f is not None:
-                f.close()
-                if f is not raw:
+            if fw is not None:
+                # The footer/trailer write can fail too (disk full at the
+                # very end): same no-leaked-fd / no-stranded-partial
+                # contract as the loop body above.
+                w0 = time.perf_counter()
+                try:
+                    fw.close()
                     raw.close()
-        ref = BlockRef.from_disk(path if f is not None else None,
+                except BaseException:
+                    try:
+                        raw.close()
+                    except OSError:
+                        pass
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    raise
+                write_secs += time.perf_counter() - w0
+        ref = BlockRef.from_disk(path if fw is not None else None,
                                  total_records, total_bytes,
                                  key_dtype, value_dtype)
         ref.store = self
-        if f is None:
+        if fw is None:
             # empty stream: nothing on disk, an empty resident block
             from .blocks import Block
 
@@ -574,6 +794,8 @@ class RunStore(object):
         stack = getattr(self._attempts, "stack", None)
         if stack:
             stack[-1].append(ref)
+        if fw is not None:
+            self.count_spill_write(_file_size(path), write_secs)
         with self._lock:
             self.merge_gens += 1
             self.merge_gen_bytes += total_bytes
@@ -607,26 +829,51 @@ class RunStore(object):
     def _spill_victims(self, victims, evicted_dev):
         """Spill I/O for already-selected victims (outside the lock).
         ``evicted_dev`` refs were HBM-resident with unevictable host
-        metadata: they offload and go straight to disk — both their device
-        bytes and host bytes were already deducted."""
+        metadata: they offload (synchronously — the device fetch is the
+        point) and then take the same write path — both their device
+        bytes and host bytes were already deducted.
+
+        With the writer pool on, victims that need a disk write enqueue
+        and the evicting thread returns immediately; their RAM stays
+        readable (and charged, via the pool's in-flight bytes) until the
+        background write publishes.  Victims that already own a durable
+        file — checkpoint-persisted refs — just drop their RAM copy, and
+        pinned/raced refs fall through to the synchronous path."""
         if not victims and not evicted_dev:
             return
         directory = os.path.join(self.root, self._stage)
-        freed = 0
         for v in evicted_dev:
             with _trace.span("hbm", "offload", bytes=v.dev_bytes):
                 v.offload()
-            with _trace.span("spill", "spill", bytes=v.nbytes,
-                             records=v.nrecords):
-                freed += v.spill(directory)
-        for v in victims:
-            with _trace.span("spill", "spill", bytes=v.nbytes,
-                             records=v.nrecords):
-                freed += v.spill(directory)
-        with self._lock:
-            self.spill_count += len(victims) + len(evicted_dev)
-            self.spilled_bytes += freed
-            self.hbm_offloads += len(evicted_dev)
+        if evicted_dev:
+            with self._lock:
+                self.hbm_offloads += len(evicted_dev)
+        pool = self.writer_pool()
+        freed_sync = n_sync = 0
+        queued = []
+        for v in evicted_dev + victims:
+            if (pool is not None and not v.pin and v.path is None
+                    and v._block is not None):
+                queued.append(v)
+            else:
+                with _trace.span("spill", "spill", bytes=v.nbytes,
+                                 records=v.nrecords):
+                    freed_sync += v.spill(directory)
+                n_sync += 1
+        if n_sync:
+            with self._lock:
+                self.spill_count += n_sync
+                self.spilled_bytes += freed_sync
+        if queued:
+            os.makedirs(directory, exist_ok=True)
+            for v in queued:
+                blk = v._block
+                if blk is None:  # raced with a concurrent drop
+                    continue
+                path = os.path.join(directory, uuid.uuid4().hex + ".blk")
+                pool.submit(v, blk, path,
+                            _spill_codec(v.key_dtype, v.value_dtype),
+                            clear_block=True)
 
     def _offload_ref(self, ref):
         """Device -> host for one ref already removed from both resident
@@ -651,11 +898,13 @@ class RunStore(object):
         place, so under host pressure those refs are evicted whole —
         offload + disk — and leave both accountings here.
 
-        In-flight overlap bytes shrink the effective residency target: the
-        pipelined map driver's windows are charged against the same budget,
-        so readahead displaces resident blocks instead of stacking on
-        top of them."""
-        target = max(0, self.budget - self._overlap_bytes)
+        In-flight overlap bytes AND queued-but-unwritten spill bytes (the
+        writer pool's backlog — that RAM is still held) shrink the
+        effective residency target: both are charged against the same
+        budget, so readahead and write queueing displace resident blocks
+        instead of stacking on top of them."""
+        inflight = 0 if self._writer is None else self._writer.inflight_bytes
+        target = max(0, self.budget - self._overlap_bytes - inflight)
         if self._resident_bytes <= target:
             return [], []
         victims = []
@@ -711,7 +960,12 @@ class RunStore(object):
 
     def cleanup(self):
         """Remove the run's scratch tree (outputs the caller wants to keep
-        must have been read or re-registered elsewhere first)."""
+        must have been read or re-registered elsewhere first).  Queued
+        background writes are aborted first — their target files are about
+        to be deleted anyway, and their refs keep their RAM blocks."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
         if os.path.isdir(self.root):
             shutil.rmtree(self.root, ignore_errors=True)
 

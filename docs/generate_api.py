@@ -24,6 +24,10 @@ MODULES = [
     "dampr_tpu.graph",
     "dampr_tpu.runner",
     "dampr_tpu.storage",
+    "dampr_tpu.io",
+    "dampr_tpu.io.codecs",
+    "dampr_tpu.io.frames",
+    "dampr_tpu.io.writer",
     "dampr_tpu.obs",
     "dampr_tpu.obs.trace",
     "dampr_tpu.obs.export",

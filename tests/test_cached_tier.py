@@ -35,6 +35,7 @@ class TestCompressedPinned:
         store = RunStore("cached-nospill", budget=8192)
         ref = store.register(Block.from_pairs([(1, 2)] * 100), pin=True)
         unpinned = store.register(_big_block(), pin=False)
+        store.drain_writes()  # spill writes are asynchronous now
         assert not unpinned.resident  # spilled to meet the 1-byte budget
         assert ref.path is None  # pinned stayed in (compressed) RAM
         assert dict(ref.get().iter_pairs()) == {1: 2}

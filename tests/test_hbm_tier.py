@@ -77,6 +77,7 @@ class TestDeviceRefs:
             store = RunStore("hbm-cascade", budget=1 << 17)
             blocks = [_mkblock(8192, key_mod=50 + i) for i in range(8)]
             refs = [store.register(b, device=True) for b in blocks]
+            store.drain_writes()  # spill writes are asynchronous now
             assert store.hbm_offloads > 0, "nothing offloaded"
             assert store.spill_count > 0, "host pressure never hit disk"
             for b, r in zip(blocks, refs):
@@ -192,6 +193,7 @@ class TestIntersections:
             store = RunStore("hbm-hostpressure", budget=1 << 14)
             blocks = [_mkblock(4096, key_mod=97 + i) for i in range(10)]
             refs = [store.register(b, device=True) for b in blocks]
+            store.drain_writes()  # spill writes are asynchronous now
             # host budget (16 KB) is far below 10 blocks' key+hash bytes
             assert store.spill_count > 0
             for b, r in zip(blocks, refs):

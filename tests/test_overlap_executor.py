@@ -118,6 +118,7 @@ class TestOverlapMemory:
         refs = [store.register(Block(arr.copy(), arr.copy()))]
         assert refs[0].resident
         store.reserve_overlap(1 << 20)  # whole budget in-flight
+        store.drain_writes()  # spill writes are asynchronous now
         assert not refs[0].resident, "resident ref not displaced"
         assert refs[0].path is not None
         assert store.spill_count >= 1

@@ -1,0 +1,293 @@
+"""Chunked-frame spill format (dampr_tpu.io): round-trip fidelity across
+codecs, coexistence with the legacy formats in one run directory, the
+truncated-footer error path, and parallel-decompress exactness."""
+
+import gzip
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from dampr_tpu import settings
+from dampr_tpu.blocks import Block
+from dampr_tpu.io import codecs, frames
+from dampr_tpu.io.frames import FrameFormatError, FrameReader
+from dampr_tpu.storage import (SPILL_WINDOW, iter_block_windows, load_block,
+                               save_block)
+
+
+def _assert_blocks_equal(a, b):
+    assert len(a) == len(b)
+    ka, kb = list(a.iter_pairs()), list(b.iter_pairs())
+    assert ka == kb
+
+
+def _object_block(n=SPILL_WINDOW + 777):
+    ks = np.empty(n, dtype=object)
+    ks[:] = ["key-%d" % (i % 997) for i in range(n)]
+    vs = np.empty(n, dtype=object)
+    vs[:] = [("v", i) for i in range(n)]
+    return Block(ks, vs)
+
+
+def _numeric_block(n=2 * SPILL_WINDOW + 31):
+    blk = Block(np.arange(n, dtype=np.int64),
+                np.linspace(0.0, 1.0, n))
+    blk.hashes()
+    return blk
+
+
+@pytest.fixture
+def fresh_settings():
+    old = (settings.spill_compress, settings.spill_codec,
+           settings.spill_read_prefetch)
+    yield
+    (settings.spill_compress, settings.spill_codec,
+     settings.spill_read_prefetch) = old
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("make", [_numeric_block, _object_block])
+    def test_save_load_exact(self, tmp_path, make):
+        blk = make()
+        p = str(tmp_path / "b.blk")
+        save_block(blk, p)
+        _assert_blocks_equal(load_block(p), blk)
+
+    def test_windows_are_bounded(self, tmp_path):
+        blk = _numeric_block(3 * SPILL_WINDOW + 5)
+        p = str(tmp_path / "b.blk")
+        save_block(blk, p)
+        ws = list(iter_block_windows(p))
+        assert len(ws) == 4
+        assert all(len(w) <= SPILL_WINDOW for w in ws)
+        _assert_blocks_equal(Block.concat(ws), blk)
+
+    def test_empty_block(self, tmp_path):
+        blk = Block(np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+        p = str(tmp_path / "e.blk")
+        save_block(blk, p)
+        back = load_block(p)
+        assert len(back) == 0
+        # the file still parses as a frame file with one (empty) frame
+        r = FrameReader(p)
+        try:
+            assert len(r) == 1 and r.records == 0
+        finally:
+            r.close()
+
+    def test_hash_lanes_survive(self, tmp_path):
+        blk = _numeric_block()
+        p = str(tmp_path / "h.blk")
+        save_block(blk, p)
+        back = load_block(p)
+        assert np.array_equal(back.h1, blk.h1)
+        assert np.array_equal(back.h2, blk.h2)
+
+    def test_composite_lane_round_trip(self, tmp_path):
+        n = SPILL_WINDOW + 9
+        blk = Block(np.arange(n, dtype=np.int64),
+                    np.stack([np.arange(n), np.arange(n) * 2], axis=1)
+                    .astype(np.int64))
+        p = str(tmp_path / "c.blk")
+        save_block(blk, p)
+        back = load_block(p)
+        assert np.array_equal(back.values, blk.values)
+
+
+class TestCodecs:
+    @pytest.mark.parametrize("name", ["raw", "zlib", "gzip", "zlib:6"])
+    def test_explicit_codec_round_trip(self, tmp_path, fresh_settings, name):
+        settings.spill_compress = name
+        blk = _object_block(SPILL_WINDOW // 2)
+        p = str(tmp_path / "c.blk")
+        save_block(blk, p)
+        _assert_blocks_equal(load_block(p), blk)
+
+    def test_optional_codecs_round_trip_or_fall_back(self, tmp_path,
+                                                     fresh_settings):
+        # With lz4/zstd installed this exercises the fast path; without,
+        # the graceful fallback — both must produce readable frames.
+        for name in ("lz4", "zstd"):
+            settings.spill_compress = name
+            blk = _object_block(SPILL_WINDOW // 4)
+            p = str(tmp_path / (name + ".blk"))
+            save_block(blk, p)
+            _assert_blocks_equal(load_block(p), blk)
+            r = FrameReader(p)
+            try:
+                cids = {e[1] for e in r.index}
+            finally:
+                r.close()
+            if codecs.available(name):
+                assert cids == {codecs._IDS[name]}
+            else:
+                assert codecs._IDS[name] not in cids  # fell back
+
+    def test_mixed_codecs_coexist_in_one_dir(self, tmp_path, fresh_settings):
+        """One run dir holding frames written under different codec
+        settings — every file self-describes via per-frame codec ids."""
+        blocks, paths = [], []
+        for i, mode in enumerate(["raw", "zlib", "gzip", "auto", "never"]):
+            settings.spill_compress = mode
+            blk = _object_block(SPILL_WINDOW // 8 + i)
+            p = str(tmp_path / ("m%d.blk" % i))
+            save_block(blk, p)
+            blocks.append(blk)
+            paths.append(p)
+        for blk, p in zip(blocks, paths):
+            _assert_blocks_equal(load_block(p), blk)
+
+    def test_missing_codec_decode_raises(self, tmp_path):
+        class FutureCodec(object):  # a codec id this build doesn't know
+            cid = 99
+
+            def compress(self, data):
+                return data
+
+        p = str(tmp_path / "bad.blk")
+        with open(p, "wb") as f:
+            w = frames.FrameWriter(f, FutureCodec())
+            w.add_frame(b"payload", records=1)
+            w.close()
+        r = FrameReader(p)
+        try:
+            with pytest.raises(codecs.MissingCodecError):
+                r.read_frame(0)
+        finally:
+            r.close()
+
+    def test_auto_resolves_and_explicit_levels_parse(self):
+        c = codecs.resolve("auto")
+        assert c.name in ("zstd", "lz4", "zlib")
+        assert codecs.resolve("zlib:7").level == 7
+        with pytest.raises(ValueError):
+            codecs.resolve("nonsense")
+
+    def test_fallback_drops_foreign_level(self):
+        # "zstd:19" on a host without zstd must NOT become zlib:19 (zlib
+        # stops at 9) — the fallback takes its own default level, and the
+        # resolved codec must actually compress.
+        c = codecs.resolve("zstd:19")
+        if c.name != "zstd":  # fell back
+            assert c.name in ("lz4", "zlib")
+        data = b"x" * 4096
+        assert c.decompress(c.compress(data)) == data
+
+
+class TestBackCompat:
+    """Pre-frame spill dirs (whole-file gzip for object lanes, plain
+    pickle-window streams for numeric) must stay readable forever: resume
+    manifests written before PR 3 reference them."""
+
+    @staticmethod
+    def _legacy_dump(block, f):
+        n = len(block)
+        for at in range(0, max(n, 1), SPILL_WINDOW):
+            end = min(at + SPILL_WINDOW, n)
+            pickle.dump(
+                (block.keys[at:end], block.values[at:end],
+                 None if block.h1 is None else block.h1[at:end],
+                 None if block.h2 is None else block.h2[at:end]),
+                f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def test_legacy_gzip_stream_reads(self, tmp_path):
+        blk = _object_block()
+        p = str(tmp_path / "old.blk")
+        with gzip.open(p, "wb", compresslevel=1) as f:
+            self._legacy_dump(blk, f)
+        _assert_blocks_equal(load_block(p), blk)
+        assert sum(len(w) for w in iter_block_windows(p)) == len(blk)
+
+    def test_legacy_plain_stream_reads(self, tmp_path):
+        blk = _numeric_block()
+        p = str(tmp_path / "old_plain.blk")
+        with open(p, "wb") as f:
+            self._legacy_dump(blk, f)
+        _assert_blocks_equal(load_block(p), blk)
+
+    def test_legacy_and_frame_files_coexist(self, tmp_path):
+        old, new = _numeric_block(), _object_block()
+        po, pn = str(tmp_path / "o.blk"), str(tmp_path / "n.blk")
+        with open(po, "wb") as f:
+            self._legacy_dump(old, f)
+        save_block(new, pn)
+        _assert_blocks_equal(load_block(po), old)
+        _assert_blocks_equal(load_block(pn), new)
+
+
+class TestTruncation:
+    def _frame_file(self, tmp_path):
+        blk = _numeric_block()
+        p = str(tmp_path / "t.blk")
+        save_block(blk, p)
+        return p
+
+    def test_truncated_footer_raises(self, tmp_path):
+        p = self._frame_file(tmp_path)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size - 7)  # clip the trailer mid-struct
+        with pytest.raises(FrameFormatError, match="trailer|truncated"):
+            list(iter_block_windows(p))
+
+    def test_truncated_mid_frames_raises(self, tmp_path):
+        p = self._frame_file(tmp_path)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(FrameFormatError):
+            list(iter_block_windows(p))
+
+    def test_corrupt_footer_pickle_raises(self, tmp_path):
+        p = self._frame_file(tmp_path)
+        r = FrameReader(p)
+        r.close()
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.seek(size - 20)
+            f.write(b"\xff" * 8)  # stomp the footer bytes
+        with pytest.raises(FrameFormatError):
+            FrameReader(p)
+
+
+class TestParallelDecode:
+    def test_prefetch_matches_serial(self, tmp_path, fresh_settings):
+        """Parallel frame decompress (prefetch on the shared executor)
+        must be byte-exact with the serial whole-block inflate."""
+        settings.spill_compress = "always"
+        blk = _object_block(6 * SPILL_WINDOW + 13)
+        p = str(tmp_path / "par.blk")
+        save_block(blk, p)
+
+        settings.spill_read_prefetch = 0
+        serial = Block.concat(list(iter_block_windows(p)))
+        settings.spill_read_prefetch = 4
+        parallel = Block.concat(list(iter_block_windows(p)))
+        _assert_blocks_equal(serial, parallel)
+        _assert_blocks_equal(parallel, blk)
+
+    def test_abandoned_prefetch_iterator_is_safe(self, tmp_path,
+                                                 fresh_settings):
+        settings.spill_read_prefetch = 4
+        blk = _numeric_block(8 * SPILL_WINDOW)
+        p = str(tmp_path / "ab.blk")
+        save_block(blk, p)
+        it = iter_block_windows(p)
+        first = next(it)
+        assert len(first) == SPILL_WINDOW
+        it.close()  # abandon mid-stream: no fd leak, no crash
+
+    def test_random_access_read_frame(self, tmp_path):
+        blk = _numeric_block(4 * SPILL_WINDOW)
+        p = str(tmp_path / "ra.blk")
+        save_block(blk, p)
+        r = FrameReader(p)
+        try:
+            assert len(r) == 4
+            # read the LAST frame without touching the first three
+            keys, _v, _h1, _h2 = frames.load_window_payload(r.read_frame(3))
+            assert np.array_equal(
+                keys, blk.keys[3 * SPILL_WINDOW:4 * SPILL_WINDOW])
+        finally:
+            r.close()

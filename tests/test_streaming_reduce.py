@@ -37,6 +37,7 @@ class TestWindowedSpill:
         store = RunStore("wintest", budget=1)  # everything spills
         n = SPILL_WINDOW + 123
         ref = store.register(Block.from_pairs([(i, i) for i in range(n)]))
+        store.drain_writes()  # spill writes are asynchronous now
         assert not ref.resident
         windows = list(ref.iter_windows())
         assert len(windows) == 2

@@ -1,0 +1,550 @@
+"""Background spill writer pool (dampr_tpu.io.writer): budget-bounded
+in-flight bytes, kill-path drain hygiene, publish ordering, checkpoint
+consistency through resume, and per-job UDF isolation (the
+``_shared_instance_deepcopy`` fix rides this PR)."""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dampr_tpu import settings
+from dampr_tpu.blocks import Block
+from dampr_tpu.io import codecs
+from dampr_tpu.storage import RunStore
+
+
+def _blk(n=20000, base=0):
+    return Block(np.arange(n, dtype=np.int64) + base,
+                 np.arange(n, dtype=np.int64) * 2 + base)
+
+
+@pytest.fixture
+def scratch(tmp_path):
+    old_scratch = settings.scratch_root
+    old_threads = settings.spill_write_threads
+    old_inflight = settings.spill_inflight_bytes
+    settings.scratch_root = str(tmp_path / "scratch")
+    yield tmp_path
+    settings.scratch_root = old_scratch
+    settings.spill_write_threads = old_threads
+    settings.spill_inflight_bytes = old_inflight
+
+
+class TestInflightBound:
+    def test_inflight_bytes_never_exceed_cap(self, scratch):
+        """The pool's charge loop admits a job only under the cap, so
+        queued-but-unwritten bytes (RAM still held) can never stack an
+        unbounded write backlog on top of the stage budget."""
+        settings.spill_inflight_bytes = 1 << 18  # 256 KB, ~1.5 blocks
+        store = RunStore("pool-bound", budget=1 << 16)
+        peaks = []
+
+        class SlowCodec(object):  # force a persistent backlog
+            cid = codecs.RAW
+
+            def compress(self, data):
+                time.sleep(0.002)
+                peaks.append(store.spill_inflight_bytes)
+                return data
+
+        import dampr_tpu.storage as storage_mod
+        orig = storage_mod._spill_codec
+        storage_mod._spill_codec = lambda *a: SlowCodec()
+        try:
+            refs = [store.register(_blk(base=i)) for i in range(12)]
+            store.drain_writes()
+        finally:
+            storage_mod._spill_codec = orig
+        blk_bytes = refs[0].nbytes
+        cap = settings.spill_inflight_bytes
+        # admission is by current backlog: the bound is cap + one block
+        assert store.spill_inflight_peak_bytes <= cap + blk_bytes, (
+            store.spill_inflight_peak_bytes, cap)
+        assert max(peaks) <= cap + blk_bytes
+        for i, r in enumerate(refs):
+            got = r.get()
+            assert np.array_equal(np.asarray(got.keys),
+                                  np.arange(20000, dtype=np.int64) + i)
+        store.cleanup()
+
+    def test_inflight_charges_shrink_victim_target(self, scratch):
+        """Queued spill bytes count against the budget exactly like
+        overlap windows: while a backlog exists, the victim selector's
+        target shrinks by the in-flight bytes."""
+        store = RunStore("pool-target", budget=1 << 20)
+        pool = store.writer_pool()
+        assert pool is not None
+        with pool._cv:
+            pool.inflight_bytes = 1 << 20  # simulate a full backlog
+        try:
+            ref = store.register(_blk())
+            store.drain_writes()
+            # the whole budget is charged to in-flight writes, so the
+            # fresh ref must have been displaced to disk
+            assert not ref.resident and ref.path is not None
+        finally:
+            with pool._cv:
+                pool.inflight_bytes = 0
+        store.cleanup()
+
+
+class TestKillDrain:
+    def test_abort_leaves_no_temp_files_and_no_charges(self, scratch):
+        settings.spill_inflight_bytes = 1 << 30
+        store = RunStore("pool-abort", budget=1)
+
+        gate = threading.Event()
+
+        class BlockingCodec(object):
+            cid = codecs.RAW
+
+            def compress(self, data):
+                gate.wait(5.0)
+                return data
+
+        import dampr_tpu.storage as storage_mod
+        orig = storage_mod._spill_codec
+        storage_mod._spill_codec = lambda *a: BlockingCodec()
+        try:
+            refs = [store.register(_blk(base=i)) for i in range(6)]
+            assert store.spill_inflight_bytes > 0
+            gate.set()
+            store.abort_writes()  # the killed-run drain
+        finally:
+            storage_mod._spill_codec = orig
+        assert store.spill_inflight_bytes == 0
+        orphans = glob.glob(os.path.join(store.root, "**", "*.tmp"),
+                            recursive=True)
+        assert orphans == [], orphans
+        # aborted refs keep their RAM blocks: nothing lost, all readable
+        for i, r in enumerate(refs):
+            got = r.get()
+            assert np.array_equal(np.asarray(got.keys),
+                                  np.arange(20000, dtype=np.int64) + i)
+        store.cleanup()
+
+    def test_write_failure_surfaces_on_drain(self, scratch):
+        store = RunStore("pool-err", budget=1)
+
+        class BoomCodec(object):
+            cid = codecs.RAW
+
+            def compress(self, data):
+                raise OSError("disk exploded")
+
+        import dampr_tpu.storage as storage_mod
+        orig = storage_mod._spill_codec
+        storage_mod._spill_codec = lambda *a: BoomCodec()
+        try:
+            ref = store.register(_blk())
+            with pytest.raises(OSError, match="disk exploded"):
+                store.drain_writes()
+        finally:
+            storage_mod._spill_codec = orig
+        # the failed write left the data in RAM and no temp litter
+        assert ref.resident
+        assert glob.glob(os.path.join(store.root, "**", "*.tmp"),
+                         recursive=True) == []
+        store.cleanup()
+
+
+class TestPublishOrder:
+    def test_block_readable_until_file_durable(self, scratch):
+        """fsync/rename publish order: until the final file exists, the
+        ref still answers from RAM; ``path`` never points at a temp or
+        half-written file."""
+        store = RunStore("pool-pub", budget=1)
+        started = threading.Event()
+        gate = threading.Event()
+
+        class GatedCodec(object):
+            cid = codecs.RAW
+
+            def compress(self, data):
+                started.set()
+                gate.wait(5.0)
+                return data
+
+        import dampr_tpu.storage as storage_mod
+        orig = storage_mod._spill_codec
+        storage_mod._spill_codec = lambda *a: GatedCodec()
+        try:
+            ref = store.register(_blk())
+            assert started.wait(5.0)
+            # mid-write: path unpublished, RAM copy still serving reads
+            assert ref.path is None and ref.resident
+            assert len(ref.get()) == 20000
+        finally:
+            storage_mod._spill_codec = orig
+            gate.set()
+        store.drain_writes()
+        assert ref.path is not None and not ref.resident
+        assert os.path.exists(ref.path) and not ref.path.endswith(".tmp")
+        assert len(ref.get()) == 20000
+        store.cleanup()
+
+    def test_dropped_ref_mid_write_leaks_nothing(self, scratch):
+        store = RunStore("pool-drop", budget=1)
+        gate = threading.Event()
+
+        class GatedCodec(object):
+            cid = codecs.RAW
+
+            def compress(self, data):
+                gate.wait(5.0)
+                return data
+
+        import dampr_tpu.storage as storage_mod
+        orig = storage_mod._spill_codec
+        storage_mod._spill_codec = lambda *a: GatedCodec()
+        try:
+            ref = store.register(_blk())
+            store.drop_ref(ref)  # delete races the queued write
+            gate.set()
+            store.drain_writes()
+        finally:
+            storage_mod._spill_codec = orig
+        blks = glob.glob(os.path.join(store.root, "**", "*.blk"),
+                         recursive=True)
+        assert blks == [], "dropped ref's spill file survived"
+        store.cleanup()
+
+    def test_concurrent_register_threads_stay_exact(self, scratch):
+        settings.spill_inflight_bytes = 1 << 16
+        store = RunStore("pool-conc", budget=1 << 16)
+        refs = [[] for _ in range(4)]
+
+        def worker(t):
+            for i in range(8):
+                refs[t].append(
+                    (t * 100 + i, store.register(_blk(4096, t * 100 + i))))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        store.drain_writes()
+        for t in range(4):
+            for base, r in refs[t]:
+                got = r.get()
+                assert np.array_equal(
+                    np.asarray(got.keys),
+                    np.arange(4096, dtype=np.int64) + base)
+        store.cleanup()
+
+
+class TestSyncPathParity:
+    def test_sync_spills_feed_io_counters(self, scratch):
+        """DAMPR_TPU_SPILL_WRITERS=0 (the async-off baseline) must still
+        report write bandwidth, or the pool can't be compared against it."""
+        settings.spill_write_threads = 0
+        store = RunStore("sync-io", budget=1)
+        ref = store.register(_blk())
+        assert not ref.resident  # synchronous: spilled before register returned
+        assert store.spill_disk_bytes > 0
+        assert store.spill_write_seconds > 0
+        store.cleanup()
+
+    def test_unknown_spill_compress_mode_degrades_to_auto(self, scratch,
+                                                          tmp_path):
+        import dampr_tpu.storage as storage_mod
+
+        old = settings.spill_compress
+        settings.spill_compress = "on"  # pre-frame configs accepted this
+        try:
+            blk = _blk(4096)
+            p = str(tmp_path / "mode.blk")
+            storage_mod.save_block(blk, p)  # must not raise
+            back = storage_mod.load_block(p)
+            assert np.array_equal(back.keys, blk.keys)
+        finally:
+            settings.spill_compress = old
+
+
+class TestResumeConsistency:
+    def _build(self, path, mark):
+        from dampr_tpu import Dampr
+
+        return (Dampr.memory(list(range(5000)), partitions=8)
+                .map(lambda x: x + mark)
+                .checkpoint(force=True))
+
+    def test_checkpoint_persist_through_pool_restores(self, scratch):
+        """resume=True persists stage outputs through the writer pool;
+        the manifests must reference only durable, loadable files."""
+        name = "pool-resume"
+        got1 = sorted(self._build(scratch, 0).run(
+            name=name, resume=True, memory_budget=1 << 14).read())
+        root = os.path.join(settings.scratch_root, name)
+        # every manifest-referenced block exists and loads
+        import json
+
+        from dampr_tpu.storage import load_block
+
+        mdir = os.path.join(root, "manifest")
+        manifests = sorted(os.listdir(mdir))
+        assert manifests
+        seen_blocks = 0
+        for m in manifests:
+            with open(os.path.join(mdir, m)) as f:
+                man = json.load(f)
+            for entry in man.get("blocks", ()):
+                p = os.path.join(root, entry[1])
+                assert os.path.exists(p), p
+                assert len(load_block(p)) == entry[2]
+                seen_blocks += 1
+        assert seen_blocks > 0
+        # a rerun restores from those checkpoints and agrees exactly
+        got2 = sorted(self._build(scratch, 0).run(
+            name=name, resume=True, memory_budget=1 << 14).read())
+        assert got1 == got2
+
+    def test_pre_frame_checkpoint_dir_restores(self, scratch):
+        """Back-compat acceptance: a checkpoint written entirely in the
+        PRE-frame wire format (what a pre-PR-3 run left on disk) must
+        restore and resume correctly with the new loader."""
+        import gzip
+        import pickle
+
+        name = "pool-oldfmt"
+        got1 = sorted(self._build(scratch, 0).run(
+            name=name, resume=True, memory_budget=1 << 30).read())
+        root = os.path.join(settings.scratch_root, name)
+        # Rewrite every checkpoint block into the legacy formats the old
+        # engine produced (gzip'd / plain pickle-window streams).
+        from dampr_tpu.storage import SPILL_WINDOW, load_block
+
+        rewritten = 0
+        for dirpath, _dirs, files in os.walk(root):
+            for fname in files:
+                if not fname.endswith(".blk"):
+                    continue
+                p = os.path.join(dirpath, fname)
+                blk = load_block(p)
+                plain = (blk.keys.dtype != object
+                         and blk.values.dtype != object)
+                opener = (open if plain
+                          else (lambda q, m: gzip.open(q, m,
+                                                       compresslevel=1)))
+                with opener(p, "wb") as f:
+                    n = len(blk)
+                    for at in range(0, max(n, 1), SPILL_WINDOW):
+                        end = min(at + SPILL_WINDOW, n)
+                        pickle.dump(
+                            (blk.keys[at:end], blk.values[at:end],
+                             None if blk.h1 is None else blk.h1[at:end],
+                             None if blk.h2 is None else blk.h2[at:end]),
+                            f, protocol=pickle.HIGHEST_PROTOCOL)
+                rewritten += 1
+        assert rewritten > 0
+        got2 = sorted(self._build(scratch, 0).run(
+            name=name, resume=True, memory_budget=1 << 30).read())
+        assert got1 == got2
+
+
+class TestStatsSurface:
+    def test_run_summary_gains_io_section(self, scratch):
+        from dampr_tpu import Dampr
+        from dampr_tpu.runner import MTRunner
+
+        pipe = (Dampr.memory(list(range(50000)), partitions=8)
+                .checkpoint(force=True))
+        runner = MTRunner("pool-stats", pipe.pmer.graph,
+                          memory_budget=1 << 14)
+        out = runner.run([pipe.source])
+        assert sorted(v for _k, v in out[0].read()) == list(range(50000))
+        io = runner.run_summary["io"]
+        for key in ("spill_write_bytes", "spill_write_seconds",
+                    "spill_write_mbps", "spill_read_bytes",
+                    "spill_read_seconds", "spill_read_mbps",
+                    "io_wait_seconds", "io_wait_fraction",
+                    "writer_threads", "inflight_peak_bytes"):
+            assert key in io, key
+        assert io["spill_write_bytes"] > 0
+        assert io["spill_read_bytes"] > 0
+        runner.store.cleanup()
+
+
+class TestUdfIsolation:
+    """The ``_shared_instance_deepcopy`` fix: stateful callable objects
+    get per-job copies; plain functions stay shared; uncopyable state
+    degrades to the shared instance with a warning."""
+
+    def test_stateful_callable_object_is_isolated_per_job(self):
+        import copy
+
+        from dampr_tpu import base
+
+        class Tagger(object):
+            def __init__(self):
+                self.seen = []
+
+            def __call__(self, k, v):
+                self.seen.append(k)
+                yield k, v
+
+        udf = Tagger()
+        op = base.Map(udf)
+        clone = copy.deepcopy(op)
+        assert clone is not op
+        assert clone.mapper is not udf
+        list(clone.mapper(1, 2))
+        assert udf.seen == [] and clone.mapper.seen == [1]
+
+    def test_plain_function_wrapper_stays_shared(self):
+        import copy
+
+        from dampr_tpu import base
+
+        def f(k, v):
+            yield k, v
+
+        op = base.Map(f)
+        assert copy.deepcopy(op) is op
+        vm = base.ValueMap(lambda v: v)
+        assert copy.deepcopy(vm) is vm
+
+    def test_attributeless_wrapper_stays_shared(self):
+        # A shared-deepcopy op with an empty (or absent) __dict__ must
+        # share, not crash on the empty-holdings fast path.
+        import copy
+
+        from dampr_tpu import base
+
+        class Bare(base.RecordOp):
+            def apply_batch(self, ks, vs):
+                return ks, vs
+
+        op = Bare()
+        assert copy.deepcopy(op) is op
+
+        class Slotted(base.RecordOp):
+            __slots__ = ()
+
+            def apply_batch(self, ks, vs):
+                return ks, vs
+
+        # __slots__ subclasses of a dict-ful base still expose __dict__;
+        # either way the clone path must not raise
+        slotted = Slotted()
+        assert copy.deepcopy(slotted) is slotted
+
+    def test_uncopyable_stateful_callable_warns_and_shares(self, tmp_path,
+                                                           caplog):
+        import copy
+        import logging
+
+        from dampr_tpu import base
+
+        fh = open(tmp_path / "res.txt", "w")
+
+        class Uncopyable(object):
+            def __init__(self):
+                self.handle = fh
+
+            def __call__(self, k, v):
+                yield k, v
+
+        try:
+            op = base.Map(Uncopyable())
+            with caplog.at_level(logging.WARNING, "dampr_tpu.base"):
+                base._share_warned.discard("Map")
+                clone = copy.deepcopy(op)
+            assert clone is op  # fell back to sharing
+            assert any("SHARED across" in r.message for r in caplog.records)
+        finally:
+            fh.close()
+
+    def test_bound_method_of_stateful_object_is_isolated(self):
+        import copy
+
+        from dampr_tpu import base
+
+        class Dedupe(object):
+            def __init__(self):
+                self.seen = set()
+
+            def check(self, k, v):
+                if k not in self.seen:
+                    self.seen.add(k)
+                    yield k, v
+
+        d = Dedupe()
+        op = base.Map(d.check)
+        clone = copy.deepcopy(op)
+        assert clone is not op
+        list(clone.mapper(1, 2))
+        assert d.seen == set(), "bound-method receiver shared across jobs"
+
+    def test_stateful_callable_inside_partial_is_isolated(self):
+        import copy
+        import functools
+
+        from dampr_tpu import base
+
+        class Acc(object):
+            def __init__(self):
+                self.seen = []
+
+            def __call__(self, k, v):
+                self.seen.append(k)
+                yield k, v
+
+        acc = Acc()
+        op = base.Map(functools.partial(acc))
+        clone = copy.deepcopy(op)
+        assert clone is not op
+        list(clone.mapper(1, 2))
+        assert acc.seen == [], "partial-wrapped stateful callable shared"
+
+    def test_uncopyable_shared_twice_in_one_pass_stays_shared(self,
+                                                              tmp_path):
+        # The memo must not retain a half-built clone when the copy
+        # fails: the SAME op referenced twice in one deepcopy pass must
+        # resolve to the shared original both times.
+        import copy
+
+        from dampr_tpu import base
+
+        fh = open(tmp_path / "res2.txt", "w")
+
+        class Uncopyable(object):
+            def __init__(self):
+                self.handle = fh
+
+            def __call__(self, k, v):
+                yield k, v
+
+        try:
+            op = base.Map(Uncopyable())
+            both = copy.deepcopy([op, op])
+            assert both[0] is op and both[1] is op
+            assert both[1].mapper.handle is fh
+        finally:
+            fh.close()
+
+    def test_concurrent_jobs_do_not_interleave_stateful_udf(self, scratch):
+        """End-to-end: a dedupe-style stateful callable sees only its own
+        job's records (pre-fix it observed every chunk's)."""
+        from dampr_tpu import Dampr
+
+        class PerJobCounter(object):
+            def __init__(self):
+                self.n = 0
+
+            def __call__(self, x):
+                self.n += 1
+                return (x, self.n)
+
+        out = dict(Dampr.memory(list(range(400)), partitions=16)
+                   .map(PerJobCounter()).run().read())
+        assert sorted(out) == list(range(400))
+        # each job's clone counts from 1; with a shared instance the max
+        # counter would reach the full record count
+        assert max(out.values()) < 400

@@ -112,6 +112,17 @@ def validate(doc, schema, require_cats=()):
         _phase_rules(events, errors)
         cats = {ev.get("cat") for ev in events
                 if ev.get("ph") in ("X", "i")}
+        # Closed category set: every span kind the engine emits is
+        # declared in the schema's x-span-kinds — an undeclared category
+        # fails validation, so new instrumentation must update the schema
+        # (and this keeps docs/trace_schema.json the authoritative list).
+        known = schema.get("x-span-kinds")
+        if known:
+            for cat in sorted(c for c in cats if c):
+                if cat not in known:
+                    errors.append(
+                        "span category {!r} is not declared in the "
+                        "schema's x-span-kinds".format(cat))
         for want in require_cats:
             if want not in cats:
                 errors.append(
