@@ -221,6 +221,11 @@ def main():
                               tio.get("spill_write_mbps", 0.0),
                               tio.get("spill_read_mbps", 0.0),
                               tio.get("io_wait_fraction", 0.0)))
+            sampler = summary.get("metrics", {}).get("sampler", {})
+            if sampler.get("samples"):
+                trial_line += "  sampler {}x @{}ms ovh {:.2%}".format(
+                    sampler["samples"], sampler.get("interval_ms", 0),
+                    sampler.get("overhead", 0.0))
             if summary.get("trace_file"):
                 trial_line += "  trace {}".format(summary["trace_file"])
             log(trial_line)
@@ -284,6 +289,10 @@ def main():
         "spill_write_mbps": summary.get("io", {}).get("spill_write_mbps"),
         "spill_read_mbps": summary.get("io", {}).get("spill_read_mbps"),
         "io_wait_fraction": summary.get("io", {}).get("io_wait_fraction"),
+        # Live metrics plane: sampler self-overhead for the winning run
+        # (None when the plane was off — the default untraced path).
+        "sampler_overhead": summary.get("metrics", {}).get(
+            "sampler", {}).get("overhead"),
         "trace_file": summary.get("trace_file"),
         "stats_file": summary.get("stats_file"),
     }))

@@ -346,10 +346,15 @@ def merge_sorted_streams(streams):
     never exceeds the per-round budget plus one window per stream.
     """
     from . import settings
+    from .obs import metrics as _metrics
     from .obs import trace as _trace
 
     its = [iter(s) for s in streams]
     n = len(its)
+    # Merge fan-in, observed per merge instance: the distribution the
+    # planner's fan-in clamp is supposed to bound (histogram in stats,
+    # sampled counter track in the trace).
+    _metrics.observe("merge.kway_streams", n)
 
     def slice_of(blk, a, b):
         return Block(
@@ -437,6 +442,7 @@ def merge_sorted_streams(streams):
                 # interval covers gather+sort, not the consumer's time.
                 _trace.complete("merge", "k-way-round", _t0,
                                 records=len(merged), streams=n)
+                _metrics.counter_add("merge.kway_records", len(merged))
                 yield merged.take(np.argsort(merged.keys, kind="stable"))
 
     return gen()

@@ -11,7 +11,14 @@ canonical workloads run from an installed package without a repo checkout.
 - ``dampr-tpu-tfidf``  — TF-IDF over a file/dir, TSV parts to --out
   (``--stats`` appends the run summary).
 - ``dampr-tpu-stats``  — pretty-print a completed run's ``stats.json``
-  and locate its Perfetto-loadable trace (see ``settings.trace``).
+  and locate its Perfetto-loadable trace (see ``settings.trace``);
+  ``--series`` renders the sampled metric time series, ``--prom`` dumps
+  Prometheus text exposition, and a run directory containing a
+  ``crashdump.json`` (the flight recorder's death artifact) makes the
+  command exit 3 so scripts detect failed runs.
+
+``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` take ``--progress`` for the live
+in-run status line (``settings.progress``).
 """
 
 import argparse
@@ -33,13 +40,24 @@ def _print_stats(emitter):
     print(export.format_summary(emitter.stats()))
 
 
+def _enable_progress():
+    from . import settings
+
+    settings.progress = True
+
+
 def wc():
     ap = argparse.ArgumentParser(description="word count (top 20)")
     ap.add_argument("path")
     ap.add_argument("--chunk-mb", type=int, default=16)
     ap.add_argument("--stats", action="store_true",
                     help="print the run's stage/spill/devtime summary")
+    ap.add_argument("--progress", action="store_true",
+                    help="live per-stage status line while the run "
+                         "executes (records/s, MB/s, spill backlog, ETA)")
     args = ap.parse_args()
+    if args.progress:
+        _enable_progress()
 
     from . import Dampr
 
@@ -61,7 +79,12 @@ def tf_idf():
     ap.add_argument("--out", default="/tmp/dampr_tpu_idfs")
     ap.add_argument("--stats", action="store_true",
                     help="print the run's stage/spill/devtime summary")
+    ap.add_argument("--progress", action="store_true",
+                    help="live per-stage status line while the run "
+                         "executes (records/s, MB/s, spill backlog, ETA)")
     args = ap.parse_args()
+    if args.progress:
+        _enable_progress()
 
     from . import Dampr
     from .ops.text import DocFreq
@@ -81,9 +104,31 @@ def tf_idf():
         _print_stats(em)
 
 
+def _report_crashdump(dump):
+    """Describe a flight-recorder crash dump on stderr (the non-zero
+    exit's why)."""
+    import json
+
+    line = "CRASHED RUN: crashdump at {}".format(dump)
+    try:
+        with open(dump) as f:
+            crash = (json.load(f).get("otherData") or {}).get("crash") or {}
+        if crash.get("reason"):
+            line += "  (reason: {}".format(crash["reason"])
+            if crash.get("exception"):
+                line += ", {}: {}".format(crash["exception"],
+                                          crash.get("message", ""))
+            line += ")"
+    except (OSError, ValueError):
+        pass
+    print(line, file=sys.stderr)
+
+
 def stats():
     """Locate and pretty-print a run's persisted stats.json (written when
-    ``settings.trace`` / DAMPR_TPU_TRACE=1 was on for the run)."""
+    ``settings.trace`` / DAMPR_TPU_TRACE=1 was on for the run).  Exits 3
+    when the run left a flight-recorder ``crashdump.json`` — scripts use
+    the exit code to detect failed runs."""
     ap = argparse.ArgumentParser(
         description="pretty-print a run's stats.json + trace location")
     ap.add_argument("run", help="run name (as passed to run(name=...)), a "
@@ -91,21 +136,62 @@ def stats():
                                 "path")
     ap.add_argument("--json", action="store_true",
                     help="dump the raw stats.json instead of formatting")
+    ap.add_argument("--series", action="store_true",
+                    help="render the sampled metric time series (counter "
+                         "events from the run's trace.json/crashdump.json)")
+    ap.add_argument("--prom", action="store_true",
+                    help="dump the run's metrics in Prometheus text "
+                         "exposition format")
     args = ap.parse_args()
 
-    from .obs import export
+    from .obs import export, flightrec
 
     summary, path = export.load_stats(args.run)
+    dump = flightrec.locate_crashdump(args.run)
     if summary is None:
+        if dump is not None:
+            # A run that died before stats landed still has its crash
+            # timeline — surface it and fail the invocation.
+            _report_crashdump(dump)
+            raise SystemExit(3)
         print("no stats.json found for {!r} (searched under {}); traced "
               "runs write one — enable settings.trace / DAMPR_TPU_TRACE=1"
               .format(args.run, export.run_trace_dir(args.run)),
               file=sys.stderr)
         raise SystemExit(2)
-    if args.json:
+    if args.prom:
+        from .obs import promtext
+
+        out = promtext.render_summary(summary)
+        if not out:
+            print("no metrics section in {} (enable the metrics plane: "
+                  "settings.metrics_interval_ms / DAMPR_TPU_METRICS_MS)"
+                  .format(path), file=sys.stderr)
+        else:
+            sys.stdout.write(out)
+    elif args.json:
         import json
 
         print(json.dumps(summary, indent=2, sort_keys=True))
     else:
         print("stats: {}".format(path))
         print(export.format_summary(summary))
+    if args.series:
+        tf = summary.get("trace_file")
+        if not tf or not os.path.isfile(tf):
+            # Fall back to the trace (or crash dump) sitting next to the
+            # stats file — trace_dir may have moved since the run.
+            for cand in ("trace.json", "crashdump.json"):
+                c = os.path.join(os.path.dirname(path), cand)
+                if os.path.isfile(c):
+                    tf = c
+                    break
+        if not tf or not os.path.isfile(tf):
+            print("no trace.json for {!r}: the time series live there as "
+                  "counter events".format(args.run), file=sys.stderr)
+        else:
+            print()
+            print(export.format_series(export.load_series(tf)))
+    if dump is not None:
+        _report_crashdump(dump)
+        raise SystemExit(3)

@@ -57,6 +57,7 @@ class SpillWriterPool(object):
         self.inflight_bytes = 0   # read by the victim selector (atomic read)
         self.inflight_peak = 0
         self._outstanding = 0
+        self.queue_peak = 0       # deepest backlog ever (stats + metrics)
         self._error = None
         self._aborting = False
 
@@ -116,6 +117,7 @@ class SpillWriterPool(object):
             self.inflight_bytes += nbytes
             self.inflight_peak = max(self.inflight_peak, self.inflight_bytes)
             self._outstanding += 1
+            self.queue_peak = max(self.queue_peak, self._outstanding)
         self._ensure_threads()
         self._q.put((ref, block, final_path, codec, clear_block, nbytes,
                      _trace.now() or time.perf_counter()))
@@ -180,12 +182,21 @@ class SpillWriterPool(object):
                 self._cv.wait(0.05)
             self._raise_pending()
 
-    def abort(self):
+    def abort(self, flush_recorder=False):
         """Kill-path drain: queued-but-unstarted writes are discarded
         (those refs keep their RAM blocks and never touched disk); a
         write a worker already started runs to completion and publishes
         normally — every ref is left in one consistent state or the
-        other, budget charges are released, and no temp files remain."""
+        other, budget charges are released, and no temp files remain.
+
+        ``flush_recorder=True`` (the RunStore.abort_writes kill path —
+        never normal close/cleanup) flushes the live flight recorder
+        BEFORE the drain, so the crash dump's final samples capture the
+        writer queue exactly as the dying run left it."""
+        if flush_recorder:
+            from ..obs import flightrec as _flightrec
+
+            _flightrec.flush_active("abort_writes")
         self._aborting = True
         try:
             with self._cv:

@@ -67,17 +67,55 @@ Surfacing: ``dampr-tpu-stats <run>`` pretty-prints a persisted summary;
 bench emits per-trial spill/trace info and the artifact paths in its
 JSON line.
 
+**The live metrics plane** (``settings.metrics_interval_ms`` /
+``DAMPR_TPU_METRICS_MS``; traced runs sample at 100 ms even when unset):
+a run-scoped registry (:mod:`.metrics`: counters, gauges, histograms —
+off costs one None-check per site, same contract as tracing) whose
+background sampler (:mod:`.sampler`) snapshots the load-bearing gauges
+— budget occupancy, writer-pool queue depth/in-flight bytes, overlap
+slots live/stalled, HBM residency, records/bytes throughput, merge
+fan-in — into an in-memory time series.  Consumers:
+
+- **counter tracks**: the series embed in ``trace.json`` as Chrome
+  ``"ph":"C"`` events, so Perfetto renders each gauge as a counter
+  track under the span lanes;
+- **live progress** (:mod:`.progress`, ``settings.progress`` /
+  ``DAMPR_TPU_PROGRESS=1``): one updating console line per stage —
+  records/s, MB/s, spill backlog, ETA;
+- **Prometheus text** (:mod:`.promtext`): ``dampr-tpu-stats --prom``
+  renders a completed run in text-exposition format; ``render()``
+  behind any HTTP handler serves a live one;
+- **flight recorder** (:mod:`.flightrec`): a bounded ring of recent
+  spans + samples, flushed to ``<run>/trace/crashdump.json`` on the
+  kill/exception path — a schema-valid mini-trace (Perfetto-loadable,
+  ``tools/validate_trace.py``-checked) whose last samples show e.g. the
+  writer-pool queue state at death.  ``dampr-tpu-stats`` exits non-zero
+  on a run directory containing one.
+
+``stats()`` gains a ``metrics`` section (final counters, per-series
+last/peak, histogram summaries) including the sampler's self-accounting:
+sample count, series drops, and the ``overhead`` self-metric (sampler
+wall / run wall — the plane measures its own cost).
+
+The consolidated guide — schemas, Perfetto counter-track how-to,
+Prometheus scrape example, crashdump shape, the CI perf gate — is
+``docs/observability.md``.
+
 For a profiler-grade XLA kernel timeline (HLO names, TPU counters) use
 the existing escape hatch instead: ``settings.profile_dir`` wraps the
 run in ``jax.profiler.trace`` for TensorBoard/xprof.
 
-Layering: :mod:`.trace` is the recorder (``Tracer``, module-level
-``span``/``instant``/``complete``/``timed_iter``); :mod:`.export`
+Layering: :mod:`.trace` is the span recorder (``Tracer``, module-level
+``span``/``instant``/``complete``/``timed_iter``); :mod:`.metrics` is
+the metric registry (module-level ``counter_add``/``gauge_set``/
+``observe``/``register_gauge``); :mod:`.sampler`, :mod:`.progress`,
+:mod:`.promtext`, :mod:`.flightrec` consume it; :mod:`.export`
 serializes (``write_trace``, ``write_stats``, ``load_stats``,
-``format_summary``).  ``MTRunner.run`` owns the lifecycle: it starts the
-tracer, builds the summary either way, and persists both files for
-traced runs.
+``format_summary``, ``load_series``).  ``MTRunner.run`` owns the
+lifecycle: it starts tracer/registry/sampler/recorder, builds the
+summary either way, and persists the files for traced runs.
 """
 
 from .trace import Tracer, complete, enabled, instant, now, span  # noqa: F401
 from . import export  # noqa: F401
+from . import metrics  # noqa: F401

@@ -65,9 +65,35 @@ def chrome_events(tracer):
     return out
 
 
-def write_trace(tracer, path):
+def counter_events(metrics, pid=1):
+    """Metrics time series -> Chrome counter-track events (``"ph":"C"``).
+
+    One event per (series, sample): Perfetto groups events sharing a
+    counter ``name`` into one counter track rendered under the span
+    lanes, so gauge history (budget occupancy, queue depth, throughput
+    counters) lines up against the timeline that caused it.  Timestamps
+    are the sampler's, relative to the metrics epoch — the runner aligns
+    that epoch with the tracer's so both clocks agree in one file."""
+    out = []
+    with metrics._mu:
+        series = {name: list(s) for name, s in metrics.series.items()}
+    for name in sorted(series):
+        for t, v in series[name]:
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            out.append({"ph": "C", "name": name, "cat": "metric",
+                        "pid": pid, "tid": 0,
+                        "ts": round(t * 1e6, 3),
+                        "args": {"value": v}})
+    return out
+
+
+def write_trace(tracer, path, metrics=None):
+    events = chrome_events(tracer)
+    if metrics is not None:
+        events.extend(counter_events(metrics))
     doc = {
-        "traceEvents": chrome_events(tracer),
+        "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "run": tracer.run,
@@ -115,6 +141,67 @@ def load_stats(run):
         return json.load(f), path
 
 
+def load_series(trace_path):
+    """Read the counter (``"ph":"C"``) events back out of a persisted
+    trace.json / crashdump.json: ``{series_name: [(ts_seconds, value)]}``.
+    The inverse of :func:`counter_events`, used by ``dampr-tpu-stats
+    --series``."""
+    with open(trace_path) as f:
+        doc = json.load(f)
+    series = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") != "C":
+            continue
+        args = ev.get("args") or {}
+        v = args.get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        series.setdefault(ev.get("name", "?"), []).append(
+            (float(ev.get("ts", 0)) / 1e6, v))
+    for s in series.values():
+        s.sort(key=lambda tv: tv[0])
+    return series
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width=24):
+    if not values:
+        return ""
+    if len(values) > width:
+        # strided downsample keeps first and last
+        idx = [i * (len(values) - 1) // (width - 1) for i in range(width)]
+        values = [values[i] for i in idx]
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK[0] * len(values)
+    return "".join(_SPARK[int((v - lo) / (hi - lo) * (len(_SPARK) - 1))]
+                   for v in values)
+
+
+def format_series(series):
+    """Human-readable table of sampled time series (the ``--series``
+    view): per series the sample count, min/mean/max/last, and a
+    sparkline of the downsampled history."""
+    if not series:
+        return ("no counter samples in this trace (metrics plane off — "
+                "enable with settings.metrics_interval_ms / "
+                "DAMPR_TPU_METRICS_MS)")
+    lines = []
+    name_w = max(len(n) for n in series)
+    lines.append("{:<{w}} {:>7} {:>12} {:>12} {:>12} {:>12}  {}".format(
+        "series", "samples", "min", "mean", "max", "last", "history",
+        w=name_w))
+    for name in sorted(series):
+        vals = [v for _t, v in series[name]]
+        lines.append(
+            "{:<{w}} {:>7} {:>12.6g} {:>12.6g} {:>12.6g} {:>12.6g}  {}"
+            .format(name, len(vals), min(vals), sum(vals) / len(vals),
+                    max(vals), vals[-1], _sparkline(vals), w=name_w))
+    return "\n".join(lines)
+
+
 def _mb(n):
     return "{:.1f} MB".format(n / 1e6)
 
@@ -150,14 +237,26 @@ def format_summary(summary):
         store.get("merge_gens", 0), _mb(store.get("merge_gen_bytes", 0))))
     io = summary.get("io", {})
     if io.get("spill_write_bytes") or io.get("spill_read_bytes"):
-        add("spill io: wrote {} @ {:.0f} MB/s · read {} @ {:.0f} MB/s · "
-            "io_wait {:.2f}s ({:.1%} of wall)".format(
-                _mb(io.get("spill_write_bytes", 0)),
-                io.get("spill_write_mbps", 0.0),
-                _mb(io.get("spill_read_bytes", 0)),
-                io.get("spill_read_mbps", 0.0),
-                io.get("io_wait_seconds", 0.0),
-                io.get("io_wait_fraction", 0.0)))
+        line = ("spill io: wrote {} @ {:.0f} MB/s · read {} @ {:.0f} MB/s "
+                "· io_wait {:.2f}s ({:.1%} of wall)".format(
+                    _mb(io.get("spill_write_bytes", 0)),
+                    io.get("spill_write_mbps", 0.0),
+                    _mb(io.get("spill_read_bytes", 0)),
+                    io.get("spill_read_mbps", 0.0),
+                    io.get("io_wait_seconds", 0.0),
+                    io.get("io_wait_fraction", 0.0)))
+        if io.get("writer_queue_peak"):
+            line += " · writer queue peak {}".format(
+                io["writer_queue_peak"])
+        add(line)
+    met = summary.get("metrics")
+    if met:
+        sm = met.get("sampler", {})
+        add("metrics: {} samples @ {} ms · {} series · drops {} · "
+            "sampler overhead {:.2%}".format(
+                sm.get("samples", 0), sm.get("interval_ms", 0),
+                len(met.get("series", {})), sm.get("series_drops", 0),
+                sm.get("overhead", 0.0)))
     if store.get("h2d_bytes") or store.get("hbm_offloads"):
         add("HBM tier: {} up, {} fetched back, {} offloads, peak {}".format(
             _mb(store.get("h2d_bytes", 0)), _mb(store.get("d2h_bytes", 0)),

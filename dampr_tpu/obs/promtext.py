@@ -1,0 +1,105 @@
+"""Prometheus text exposition (version 0.0.4) for the metrics plane.
+
+Dependency-free rendering of a run's metrics — either a live
+:class:`~.metrics.Metrics` registry or the persisted ``metrics``
+section of ``stats.json`` — as the text format every Prometheus scraper
+and ``promtool`` ingests::
+
+    # TYPE dampr_tpu_store_records counter
+    dampr_tpu_store_records{run="bench-tfidf"} 1.2345e+06
+
+Engine metric names are dotted (``writer.queue_depth``); exposition
+names flatten to ``dampr_tpu_writer_queue_depth``.  Counters export as
+``counter``, sampled gauges as ``gauge`` (last sample), histograms as a
+``summary``-style ``_count``/``_sum`` pair plus ``_min``/``_max``
+gauges.  The ``dampr-tpu-stats --prom`` CLI renders a completed run;
+serving a live run is one ``render(metrics.active())`` behind any HTTP
+handler (a scrape example lives in docs/observability.md).
+"""
+
+import re
+
+_PREFIX = "dampr_tpu_"
+_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize(name):
+    """Dotted engine metric name -> a legal Prometheus metric name."""
+    out = _PREFIX + _BAD.sub("_", str(name))
+    if out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _labels(run):
+    if not run:
+        return ""
+    return '{{run="{}"}}'.format(str(run).replace("\\", "\\\\")
+                                 .replace('"', '\\"'))
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    return repr(float(v))
+
+
+def _emit(lines, name, typ, value, run):
+    lines.append("# TYPE {} {}".format(name, typ))
+    lines.append("{}{} {}".format(name, _labels(run), _num(value)))
+
+
+def render(metrics):
+    """A live registry -> exposition text (counters, current gauges,
+    histogram summaries, sampler self-metrics)."""
+    summary = metrics.summary()
+    # Live gauges beat the last sample: snapshot() pulls callbacks now.
+    snap = metrics.snapshot()
+    series = {name: {"last": v} for name, v in snap.items()}
+    for k, meta in summary.get("series", {}).items():
+        series.setdefault(k, {"last": meta.get("last")})
+    summary = dict(summary, series={
+        k: {"last": v["last"], "samples": 0, "peak": v["last"]}
+        for k, v in series.items()})
+    return render_summary({"metrics": summary, "run": metrics.run})
+
+
+def render_summary(stats_summary):
+    """A persisted stats.json dict (or a fragment with a ``metrics``
+    key) -> exposition text.  Returns "" when the run carried no
+    metrics section (pre-metrics stats files stay renderable)."""
+    m = stats_summary.get("metrics") or {}
+    run = stats_summary.get("run")
+    lines = []
+    counters = m.get("counters") or {}
+    series = m.get("series") or {}
+    for name in sorted(counters):
+        _emit(lines, sanitize(name) + "_total", "counter", counters[name],
+              run)
+    for name in sorted(series):
+        if name in counters:
+            continue  # already exported as a counter
+        meta = series[name]
+        if not isinstance(meta, dict) or "last" not in meta:
+            continue
+        v = meta["last"]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        _emit(lines, sanitize(name), "gauge", v, run)
+    for name in sorted(m.get("histograms") or {}):
+        h = m["histograms"][name]
+        base = sanitize(name)
+        lines.append("# TYPE {} summary".format(base))
+        lines.append("{}_count{} {}".format(base, _labels(run),
+                                            _num(h.get("count", 0))))
+        lines.append("{}_sum{} {}".format(base, _labels(run),
+                                          _num(h.get("sum", 0.0))))
+        for k in ("min", "max"):
+            if k in h:
+                _emit(lines, "{}_{}".format(base, k), "gauge", h[k], run)
+    sampler = m.get("sampler") or {}
+    for k in ("samples", "series_drops", "overhead"):
+        if k in sampler:
+            _emit(lines, sanitize("sampler." + k), "gauge", sampler[k],
+                  run)
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -94,6 +94,12 @@ class Tracer(object):
         self.wall_start = time.time()
         self.events = []
         self.lane_names = {}   # lane id -> display name
+        #: Optional flight recorder (obs.flightrec): every recorded span
+        #: is mirrored into its bounded ring so a killed run's crashdump
+        #: carries the most recent timeline tail.  None costs one
+        #: attribute load per recorded event (never on the disabled
+        #: path, which returns before _record).
+        self.recorder = None
 
     # -- recording ---------------------------------------------------------
     def _record(self, cat, name, t0, dur, lane, args):
@@ -104,6 +110,10 @@ class Tracer(object):
         elif lane not in self.lane_names:
             self.lane_names[lane] = str(lane)
         self.events.append((cat, name, t0 - self.epoch, dur, lane, args))
+        rec = self.recorder
+        if rec is not None:
+            rec.record_span(cat, name, t0, dur, lane,
+                            self.lane_names.get(lane), args)
 
     def span(self, cat, name, lane=None, **args):
         return _Span(self, cat, name, lane, args or None)

@@ -100,6 +100,18 @@ def slot_unstall():
         _roll_union_locked()
 
 
+def live_slots():
+    """Overlap fold consumers currently alive (unlocked read: a sampled
+    gauge tolerates a one-off torn value)."""
+    return _slots
+
+
+def stalled_slots():
+    """Slots currently blocked on their producer's codec — the live
+    consumer-stall state the metrics sampler snapshots."""
+    return _stalled
+
+
 @contextlib.contextmanager
 def track(kind):
     t0 = time.perf_counter()

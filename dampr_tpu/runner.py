@@ -39,6 +39,7 @@ from . import base, settings, storage
 from .blocks import Block, BlockBuilder
 from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
 from .graph import GInput, GMap, GReduce, GSink
+from .obs import metrics as _metrics
 from .obs import trace as _trace
 from .ops import segment
 
@@ -152,6 +153,7 @@ def _overlap_stream(items, store, size_of=None):
                     # keep that contract rather than crash size_of.
                     continue
                 nb = size_of(item) or 0
+                _metrics.counter_add("overlap.windows", 1)
                 if nb:
                     store.reserve_overlap(nb)
                 placed = False
@@ -222,6 +224,7 @@ def _overlap_stream(items, store, size_of=None):
                     # blocked on its producer) — the per-slot view of what
                     # devtime's codec_wait aggregates across all slots.
                     _trace.complete("stall", "pipe-wait", wait_t0)
+                    _metrics.counter_add("overlap.consumer_stalls", 1)
                 if item is _END:
                     if state["err"] is not None:
                         raise state["err"]
@@ -733,6 +736,16 @@ class MTRunner(object):
         # built for every run — it is how StageStats reaches users.
         self.tracer = None
         self.run_summary = None
+        # Live metrics plane: registry + sampler while
+        # settings.effective_metrics_interval_ms() > 0, flight recorder
+        # whenever tracing or metrics is on, progress reporter under
+        # settings.progress.  _status is the progress line's live stage
+        # view (plain dict: single-writer per key, display-only reads).
+        self.metrics = None
+        self.flightrec = None
+        self._sampler = None
+        self._progress = None
+        self._status = {}
 
     # -- job fan-out --------------------------------------------------------
     def _pool_run(self, fn, jobs, n_workers, label=None):
@@ -766,6 +779,24 @@ class MTRunner(object):
                 #                          worker thread = one lane per slot
                 with _trace.span("job", label):
                     return _inner(job)
+
+        m = _metrics.active()
+        if m is not None:
+            # Active-jobs accounting + the progress line's per-stage job
+            # tally.  Outermost wrapper: a retried job counts once per
+            # attempt started/done, so the active gauge stays balanced.
+            st = self._status
+            st["jobs_total"] = len(jobs)
+            st["jobs_done"] = 0
+            metered = fn
+
+            def fn(job, _inner=metered):  # noqa: F811
+                m.counter_add("run.jobs_started", 1)
+                try:
+                    return _inner(job)
+                finally:
+                    m.counter_add("run.jobs_done", 1)
+                    st["jobs_done"] = st.get("jobs_done", 0) + 1
 
         n_workers = max(1, min(n_workers, len(jobs), settings.max_processes))
         if n_workers == 1 or len(jobs) <= 1:
@@ -921,6 +952,13 @@ class MTRunner(object):
             to_merge = runs[:touched]
             keep = runs[touched:]
             groups = [g for g in (to_merge[i::m] for i in range(m)) if g]
+            if _metrics.enabled():
+                # Merge shape per generation: fan-in distribution and the
+                # live run count the planner is working down.
+                _metrics.counter_add("merge.generations", 1)
+                _metrics.gauge_set("merge.runs", len(runs))
+                for g in groups:
+                    _metrics.observe("merge.fanin", len(g))
             log.info(
                 "sorted-run merge generation: %d runs over fan-in %d — "
                 "merging %d smallest into %d group(s) on %d worker(s)",
@@ -2026,11 +2064,60 @@ class MTRunner(object):
         return src.hash_routed and src.hash_sorted
 
     # -- main walk ---------------------------------------------------------
-    def run(self, outputs, cleanup=True):
+    def _register_gauges(self):
+        """Install the load-bearing pull gauges once per run: the hot
+        paths whose state they expose pay nothing — the background
+        sampler evaluates these callbacks on its cadence."""
         from .ops import devtime
 
-        wall_start = time.time()
-        epoch = devtime.epoch()
+        m = self.metrics
+        sto = self.store
+        m.register_gauge("store.resident_bytes",
+                         lambda: sto._resident_bytes)
+        m.register_gauge(
+            "store.budget_occupancy",
+            lambda: (sto._resident_bytes / sto.budget) if sto.budget
+            else 0.0)
+        m.register_gauge("store.overlap_bytes", lambda: sto._overlap_bytes)
+        m.register_gauge("store.hbm_bytes", lambda: sto._dev_bytes)
+        m.register_gauge("store.spilled_bytes", lambda: sto.spilled_bytes)
+
+        def _writer(attr):
+            w = sto._writer
+            return 0 if w is None else getattr(w, attr)
+
+        m.register_gauge("writer.queue_depth",
+                         lambda: _writer("_outstanding"))
+        m.register_gauge("writer.inflight_bytes",
+                         lambda: _writer("inflight_bytes"))
+        m.register_gauge("overlap.live_slots", devtime.live_slots)
+        m.register_gauge("overlap.stalled_slots", devtime.stalled_slots)
+        m.register_gauge(
+            "run.active_jobs",
+            lambda: m.counters.get("run.jobs_started", 0)
+            - m.counters.get("run.jobs_done", 0))
+
+    def _start_obs(self):
+        """Run-scoped observability setup: tracer (settings.trace),
+        flight recorder (tracing OR metrics on), metrics registry +
+        sampler (effective_metrics_interval_ms > 0), progress reporter
+        (settings.progress).  Returns the flight recorder (the failure
+        path flushes it)."""
+        from .obs import flightrec as _flightrec
+
+        interval = settings.effective_metrics_interval_ms()
+        rec = None
+        if settings.trace or interval > 0:
+            # A crashdump describes the LATEST run under this name: a
+            # stale one from an earlier failure must not keep failing
+            # dampr-tpu-stats after the rerun succeeds.
+            _flightrec.clear_stale(self.name)
+        if settings.flight_recorder_events > 0 and (settings.trace
+                                                    or interval > 0):
+            rec = _flightrec.FlightRecorder(
+                self.name, settings.flight_recorder_events)
+            self.flightrec = rec
+            _flightrec.start(rec)
         if settings.trace:
             # Run-scoped engine timeline.  The tracer is process-global
             # while active (instrumentation sites are free functions);
@@ -2038,7 +2125,50 @@ class MTRunner(object):
             # into the innermost tracer — run-level metrics stay exact
             # regardless (they come from this runner's own counters).
             self.tracer = _trace.Tracer(self.name)
+            self.tracer.recorder = rec
             _trace.start(self.tracer)
+        if interval > 0:
+            from .obs.metrics import Metrics
+            from .obs.sampler import Sampler
+
+            self.metrics = Metrics(self.name)
+            if self.tracer is not None:
+                # One clock: counter events and span events share the
+                # tracer's epoch inside trace.json.
+                self.metrics.epoch = self.tracer.epoch
+            self._register_gauges()
+            _metrics.start(self.metrics)
+            self._sampler = Sampler(self.metrics, interval, recorder=rec)
+            self._sampler.start()
+            if settings.progress:
+                from .obs.progress import ProgressReporter
+
+                self._progress = ProgressReporter(
+                    self.metrics, lambda: dict(self._status),
+                    settings.progress_interval_ms)
+                self._progress.start()
+        return rec
+
+    def _stop_obs(self):
+        from .obs import flightrec as _flightrec
+
+        if self._progress is not None:
+            self._progress.stop()
+        if self._sampler is not None:
+            self._sampler.stop()
+        if self.metrics is not None:
+            _metrics.stop(self.metrics)
+        if self.tracer is not None:
+            _trace.stop(self.tracer)
+        if self.flightrec is not None:
+            _flightrec.stop(self.flightrec)
+
+    def run(self, outputs, cleanup=True):
+        from .ops import devtime
+
+        wall_start = time.time()
+        epoch = devtime.epoch()
+        rec = self._start_obs()
         try:
             if settings.profile_dir:
                 import jax
@@ -2046,9 +2176,20 @@ class MTRunner(object):
                 with jax.profiler.trace(settings.profile_dir):
                     return self._run(outputs, cleanup)
             return self._run(outputs, cleanup)
+        except BaseException as e:
+            # The flight recorder's whole reason to exist: a dying run —
+            # stage exception, KeyboardInterrupt, SIGTERM-raised exit —
+            # leaves a bounded timeline tail with the last gauge samples
+            # (writer-pool queue state included) instead of nothing.
+            if rec is not None:
+                if self._sampler is not None:
+                    # One last snapshot so the dump's final samples show
+                    # the state at death, not the previous cadence tick.
+                    self._sampler.stop()
+                rec.flush("run-failed", e)
+            raise
         finally:
-            if self.tracer is not None:
-                _trace.stop(self.tracer)
+            self._stop_obs()
             try:
                 # Built on failure too: a partial timeline + stage stats
                 # is exactly what a crashed run's postmortem needs.
@@ -2117,6 +2258,7 @@ class MTRunner(object):
                 "writer_threads": settings.spill_write_threads,
                 "read_prefetch": settings.spill_read_prefetch,
                 "inflight_peak_bytes": sto.spill_inflight_peak_bytes,
+                "writer_queue_peak": sto.spill_queue_peak,
             },
             "store": {
                 "budget": sto.budget,
@@ -2140,12 +2282,20 @@ class MTRunner(object):
             "trace_file": None,
             "stats_file": None,
         }
+        if self.metrics is not None:
+            # Counters, gauge peaks/lasts, histogram summaries, and the
+            # sampler's self-accounting (samples, series drops, the
+            # overhead self-metric) — the metrics plane measuring itself.
+            summary["metrics"] = self.metrics.summary()
+        if self.flightrec is not None and self.flightrec.path:
+            summary["crashdump_file"] = self.flightrec.path
         if self.tracer is not None:
             summary["spans"] = self.tracer.span_summary()
             tdir = _export.run_trace_dir(self.name)
             os.makedirs(tdir, exist_ok=True)
             summary["trace_file"] = _export.write_trace(
-                self.tracer, os.path.join(tdir, _export.TRACE_FILE))
+                self.tracer, os.path.join(tdir, _export.TRACE_FILE),
+                metrics=self.metrics)
             spath = os.path.join(tdir, _export.STATS_FILE)
             summary["stats_file"] = spath
             _export.write_stats(summary, spath)
@@ -2269,6 +2419,16 @@ class MTRunner(object):
             if isinstance(stage, GInput):
                 env[stage.output] = stage.tap
                 continue
+            if _metrics.enabled():
+                # The progress line's live stage view + a sampled stage
+                # gauge, so the time series shows stage boundaries.
+                self._status.update({
+                    "sid": sid + 1, "n_stages": n_stages,
+                    "kind": ("map" if isinstance(stage, GMap) else
+                             "reduce" if isinstance(stage, GReduce)
+                             else "sink"),
+                    "stage_t0": t0, "jobs_total": 0, "jobs_done": 0})
+                _metrics.gauge_set("run.stage", sid)
 
             if required is not None and sid not in required:
                 log.info("Stage %s/%s skipped: every consumer was restored "

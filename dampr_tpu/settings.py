@@ -333,6 +333,55 @@ trace = os.environ.get("DAMPR_TPU_TRACE", "0").lower() not in (
 #: outputs; a path pins every run's artifacts under <trace_dir>/<run>/.
 trace_dir = os.environ.get("DAMPR_TPU_TRACE_DIR") or None
 
+#: Live metrics plane (dampr_tpu.obs.metrics): sampling cadence in
+#: milliseconds for the background gauge sampler.  0 (the default)
+#: disables the metrics registry entirely — every instrumentation site
+#: is one module-global None-check, no sampler thread is spawned, same
+#: contract as ``trace``.  >0 starts a run-scoped registry + sampler:
+#: gauges (budget occupancy, writer-pool queue depth, overlap windows,
+#: HBM residency, records/bytes throughput) snapshot on this cadence
+#: into an in-memory time series that lands in the Perfetto trace as
+#: counter tracks, feeds the live progress reporter, and rides the
+#: flight recorder into ``crashdump.json`` on failure.  Traced runs
+#: (``trace=True``) sample at 100 ms even when this is 0, so a killed
+#: traced run always leaves a crash timeline with recent samples.
+metrics_interval_ms = int(os.environ.get("DAMPR_TPU_METRICS_MS", "0"))
+
+
+def effective_metrics_interval_ms():
+    """The sampling cadence actually in force: the explicit setting, or
+    the 100 ms traced-run default (a traced run's crashdump must carry
+    recent gauge samples), or 0 = metrics plane off."""
+    if metrics_interval_ms > 0:
+        return metrics_interval_ms
+    if trace or progress:
+        return 100
+    return 0
+
+
+#: Live in-run progress reporter (dampr_tpu.obs.progress): when True,
+#: runs print a single updating console line per stage — records/s,
+#: MB/s, spill backlog, ETA — to stderr on ``progress_interval_ms``
+#: cadence.  Implies the metrics plane (the reporter reads its gauges),
+#: so a progress-enabled run samples even with metrics_interval_ms=0.
+progress = os.environ.get("DAMPR_TPU_PROGRESS", "0").lower() not in (
+    "0", "false", "no", "off", "")
+
+#: Progress reporter refresh cadence (milliseconds).
+progress_interval_ms = int(os.environ.get("DAMPR_TPU_PROGRESS_MS", "500"))
+
+#: Flight recorder ring capacity (events): the bounded tail of recent
+#: spans + metric samples flushed to ``crashdump.json`` when a run dies
+#: (see dampr_tpu.obs.flightrec).  Bounds the crash artifact regardless
+#: of run size.  0 disables the recorder.
+flight_recorder_events = int(os.environ.get(
+    "DAMPR_TPU_FLIGHTREC_EVENTS", "1024"))
+
+#: Cap on retained samples per time series (oldest samples drop past it;
+#: the registry counts drops so ``stats()`` reports them).
+metrics_series_cap = int(os.environ.get(
+    "DAMPR_TPU_METRICS_SERIES_CAP", "4096"))
+
 #: Partition-size threshold (bytes) above which a single-input reduce streams
 #: a k-way merge over hash-sorted runs instead of materializing the partition
 #: (groups then arrive in hash order, not key order).  None = use

@@ -38,6 +38,7 @@ from . import settings
 from .io import codecs as _codecs
 from .io import frames as _frames
 from .io.writer import SpillWriterPool
+from .obs import metrics as _metrics
 from .obs import trace as _trace
 
 log = logging.getLogger("dampr_tpu.storage")
@@ -570,6 +571,13 @@ class RunStore(object):
         w = self._writer
         return 0 if w is None else w.inflight_peak
 
+    @property
+    def spill_queue_peak(self):
+        """Deepest the writer pool's backlog ever got (queued writes) —
+        the ``io.writer_queue_peak`` stats field."""
+        w = self._writer
+        return 0 if w is None else w.queue_peak
+
     def writer_pool(self):
         """The store's background spill writer, or None when disabled
         (``settings.spill_write_threads = 0`` keeps the synchronous
@@ -628,9 +636,12 @@ class RunStore(object):
     def abort_writes(self):
         """Kill-path drain: queued-but-unstarted writes are discarded
         (refs keep their RAM blocks); in-flight writes finish and publish.
-        Budget charges released, no temp files left."""
+        Budget charges released, no temp files left.  The pool flushes
+        the live flight recorder first, so the crash dump's last samples
+        still show the queue state at death (this runs only on failing
+        runs — normal teardown goes through cleanup/close)."""
         if self._writer is not None:
-            self._writer.abort()
+            self._writer.abort(flush_recorder=True)
 
     # -- overlap (pipelined map driver) accounting --------------------------
     @property
@@ -684,6 +695,13 @@ class RunStore(object):
                 and len(block) >= settings.hbm_min_records):
             prep = BlockRef.lane_prep(block.values)
         ref = BlockRef(block, store=self, pin=pin, device_prep=prep)
+        if _metrics.enabled():
+            # Stage-output throughput: every materialized block crosses
+            # here, so records/s and MB/s difference off these counters
+            # (the progress line and the sampled series both do).
+            _metrics.counter_add("store.records", ref.nrecords)
+            _metrics.counter_add("store.bytes", ref.nbytes + ref.dev_bytes)
+            _metrics.counter_add("store.blocks", 1)
         stack = getattr(self._attempts, "stack", None)
         if stack:
             stack[-1].append(ref)
@@ -796,6 +814,10 @@ class RunStore(object):
             stack[-1].append(ref)
         if fw is not None:
             self.count_spill_write(_file_size(path), write_secs)
+        if _metrics.enabled():
+            _metrics.counter_add("store.records", total_records)
+            _metrics.counter_add("store.bytes", total_bytes)
+            _metrics.counter_add("store.blocks", 1)
         with self._lock:
             self.merge_gens += 1
             self.merge_gen_bytes += total_bytes

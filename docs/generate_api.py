@@ -30,6 +30,11 @@ MODULES = [
     "dampr_tpu.io.writer",
     "dampr_tpu.obs",
     "dampr_tpu.obs.trace",
+    "dampr_tpu.obs.metrics",
+    "dampr_tpu.obs.sampler",
+    "dampr_tpu.obs.progress",
+    "dampr_tpu.obs.promtext",
+    "dampr_tpu.obs.flightrec",
     "dampr_tpu.obs.export",
     "dampr_tpu.resume",
     "dampr_tpu.settings",

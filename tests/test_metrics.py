@@ -1,0 +1,490 @@
+"""Live metrics plane (dampr_tpu.obs.metrics/sampler/flightrec/progress/
+promtext + tools/check_bench): disabled-path pin, sampler cadence and
+monotonic timestamps, flight-recorder crash dumps on stage failure and
+kill, ring-buffer bound under span flood, counter events in the trace,
+stats surface (writer queue peak, sampler drops, overhead self-metric),
+the stats CLI's series/prom/crashdump behaviors, and the CI perf gate.
+"""
+
+import importlib.util
+import json
+import operator
+import os
+import threading
+import time
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.obs import export, flightrec, metrics, promtext, trace
+from dampr_tpu.obs.flightrec import FlightRecorder
+from dampr_tpu.obs.metrics import Metrics
+from dampr_tpu.obs.progress import ProgressReporter
+from dampr_tpu.obs.sampler import Sampler
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate_trace = _load_tool("validate_trace")
+check_bench = _load_tool("check_bench")
+
+with open(os.path.join(ROOT, "docs", "trace_schema.json")) as _f:
+    TRACE_SCHEMA = json.load(_f)
+
+
+@pytest.fixture
+def metered(tmp_path):
+    """Metrics plane + tracing on for one test, artifacts under
+    tmp_path."""
+    old = (settings.trace, settings.trace_dir, settings.metrics_interval_ms)
+    settings.trace = True
+    settings.trace_dir = str(tmp_path)
+    settings.metrics_interval_ms = 10
+    yield tmp_path
+    (settings.trace, settings.trace_dir,
+     settings.metrics_interval_ms) = old
+
+
+def _obs_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name in ("dampr-tpu-sampler", "dampr-tpu-progress")]
+
+
+class TestDisabledPath:
+    def test_no_registry_no_sampler_no_cost(self):
+        """The default-off pin: no sampler thread, module-level call
+        sites are one None-check no-ops, stats carries no metrics
+        section."""
+        assert settings.effective_metrics_interval_ms() == 0
+        assert not metrics.enabled()
+        assert metrics.active() is None
+        # the instrumentation surface is inert (would raise if it tried
+        # to touch a registry)
+        metrics.counter_add("x", 5)
+        metrics.gauge_set("y", 1.0)
+        metrics.observe("z", 2.0)
+        metrics.register_gauge("w", lambda: 1)
+        em = Dampr.memory(list(range(2000))).map(lambda x: (x, 1)).run()
+        assert "metrics" not in em.stats()
+        assert not _obs_threads()
+        em.delete()
+
+    def test_sampler_thread_scoped_to_run(self, metered):
+        em = Dampr.memory(list(range(2000))).map(lambda x: (x, 1)).run(
+            name="scoped")
+        # sampler stopped and joined at run teardown
+        assert not _obs_threads()
+        assert em.stats()["metrics"]["sampler"]["samples"] >= 1
+        em.delete()
+
+
+class TestSampler:
+    def test_cadence_and_monotonic_timestamps(self):
+        m = Metrics("cadence")
+        state = {"v": 0}
+        m.register_gauge("g", lambda: state["v"])
+        s = Sampler(m, interval_ms=10)
+        s.start()
+        for i in range(10):
+            state["v"] = i
+            time.sleep(0.02)
+        s.stop()
+        assert not s.alive
+        assert m.sample_count >= 5  # ~20 expected; loaded boxes lag
+        series = m.series["g"]
+        ts = [t for t, _v in series]
+        assert ts == sorted(ts), "sampler timestamps must be monotonic"
+        assert all(t >= 0 for t in ts)
+        # cadence property: samples are spread out, not a burst — the
+        # span of the series covers most of the sampled window
+        assert ts[-1] - ts[0] > 0.05
+        # the gauge's evolution was captured
+        vals = [v for _t, v in series]
+        assert vals[-1] >= vals[0]
+        # self-accounting present and sane
+        assert m.sample_seconds >= 0
+        assert 0 <= m.overhead() < 1
+
+    def test_series_cap_and_drop_count(self, monkeypatch):
+        monkeypatch.setattr(settings, "metrics_series_cap", 8)
+        m = Metrics("cap")
+        for i in range(50):
+            m.record_sample(float(i), {"g": i}, 0.0)
+        assert len(m.series["g"]) == 8
+        assert m.series_drops == 42
+        # the retained tail is the most recent samples
+        assert [v for _t, v in m.series["g"]] == list(range(42, 50))
+
+    def test_broken_gauge_dropped_not_fatal(self):
+        m = Metrics("broken")
+
+        def bad():
+            raise RuntimeError("gauge exploded")
+
+        m.register_gauge("bad", bad)
+        m.register_gauge("good", lambda: 7)
+        snap = m.snapshot()
+        assert snap["good"] == 7 and "bad" not in snap
+        # dead callback evicted: later snapshots don't re-raise
+        assert "bad" not in m.gauge_fns
+        assert m.snapshot()["good"] == 7
+
+
+class TestFlightRecorder:
+    def test_ring_bound_under_span_flood(self):
+        rec = FlightRecorder("flood", capacity=64)
+        for i in range(10000):
+            rec.record_span("fold", "s{}".format(i), float(i), 0.001,
+                            1, "lane", None)
+        assert len(rec) <= 64
+        assert rec.drops > 0
+
+    def test_flush_is_schema_valid(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(settings, "trace_dir", str(tmp_path))
+        rec = FlightRecorder("flush-unit", capacity=32)
+        rec.record_span("spill", "w", time.perf_counter(), 0.01, 3,
+                        "writer-0", {"bytes": 10})
+        rec.record_sample(time.perf_counter(),
+                          {"writer.queue_depth": 4, "skip": "str"})
+        path = rec.flush("unit-test", ValueError("boom"))
+        assert path and os.path.isfile(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert not validate_trace.validate(doc, TRACE_SCHEMA)
+        crash = doc["otherData"]["crash"]
+        assert crash["reason"] == "unit-test"
+        assert crash["exception"] == "ValueError"
+        cvals = [ev for ev in doc["traceEvents"] if ev["ph"] == "C"]
+        assert cvals and all(isinstance(ev["args"]["value"], (int, float))
+                             for ev in cvals)
+        # non-numeric sample entries are filtered, not emitted
+        assert not any(ev["name"] == "skip" for ev in cvals)
+
+    def test_injected_stage_failure_leaves_crashdump(self, metered):
+        def boom(x):
+            if x == 333:
+                raise RuntimeError("injected")
+            return (x, x)
+
+        with pytest.raises(RuntimeError, match="injected"):
+            Dampr.memory(list(range(2000))).map(boom).run(name="inj")
+        dump = flightrec.locate_crashdump("inj")
+        assert dump and os.path.isfile(dump)
+        with open(dump) as f:
+            doc = json.load(f)
+        assert not validate_trace.validate(doc, TRACE_SCHEMA), (
+            validate_trace.validate(doc, TRACE_SCHEMA))
+        crash = doc["otherData"]["crash"]
+        assert crash["exception"] == "RuntimeError"
+        # the dump carries recent samples incl. the writer-pool gauges
+        cevents = [ev for ev in doc["traceEvents"] if ev["ph"] == "C"]
+        cnames = {ev["name"] for ev in cevents}
+        assert "writer.queue_depth" in cnames
+        assert "writer.inflight_bytes" in cnames
+        # sample timestamps share the span clock (the recorder converts
+        # absolute perf_counter values against one epoch) — they must
+        # not all collapse to 0
+        xts = [ev["ts"] for ev in doc["traceEvents"] if ev["ph"] == "X"]
+        cts = [ev["ts"] for ev in cevents]
+        if xts:
+            assert max(cts) > 0
+            assert max(cts) <= max(xts) + 10e6  # same order of magnitude
+
+    def test_kill_leaves_crashdump(self, metered):
+        def kill(x):
+            if x == 999:
+                raise KeyboardInterrupt()
+            return (x, x)
+
+        with pytest.raises(KeyboardInterrupt):
+            Dampr.memory(list(range(3000))).map(kill).run(name="killed")
+        dump = flightrec.locate_crashdump("killed")
+        assert dump and os.path.isfile(dump)
+        with open(dump) as f:
+            doc = json.load(f)
+        assert not validate_trace.validate(doc, TRACE_SCHEMA)
+        assert doc["otherData"]["crash"]["exception"] == (
+            "KeyboardInterrupt")
+
+    def test_healthy_run_leaves_no_crashdump(self, metered):
+        em = Dampr.memory(list(range(500))).map(lambda x: (x, 1)).run(
+            name="healthy")
+        em.delete()
+        assert flightrec.locate_crashdump("healthy") is None
+
+    def test_successful_rerun_clears_stale_crashdump(self, metered):
+        """A crashdump describes the LATEST run under a name: after a
+        failed run, a successful rerun must clear it (and the stats
+        CLI's exit-3 with it)."""
+        def flaky(x):
+            if x == 7:
+                raise RuntimeError("first attempt dies")
+            return (x, x)
+
+        with pytest.raises(RuntimeError):
+            Dampr.memory(list(range(100))).map(flaky).run(name="rerun")
+        assert flightrec.locate_crashdump("rerun") is not None
+        em = Dampr.memory(list(range(100))).map(
+            lambda x: (x, x)).run(name="rerun")
+        em.delete()
+        assert flightrec.locate_crashdump("rerun") is None
+
+
+class TestTraceCounterEvents:
+    def test_counter_tracks_in_trace_and_validator(self, metered):
+        em = (Dampr.memory(list(range(60000)))
+              .map(lambda x: (x % 101, 1))
+              .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+              .run(name="tracks"))
+        summary = em.stats()
+        with open(summary["trace_file"]) as f:
+            doc = json.load(f)
+        errors = validate_trace.validate(
+            doc, TRACE_SCHEMA,
+            require_counters=("store.resident_bytes",
+                              "writer.queue_depth", "run.active_jobs"))
+        assert not errors, errors
+        cevents = [ev for ev in doc["traceEvents"] if ev["ph"] == "C"]
+        assert cevents
+        # per-series timestamps non-decreasing (validator also pins this)
+        by_name = {}
+        for ev in cevents:
+            by_name.setdefault(ev["name"], []).append(ev["ts"])
+        for name, ts in by_name.items():
+            assert ts == sorted(ts), name
+        # series round-trip through the CLI loader
+        series = export.load_series(summary["trace_file"])
+        assert "store.resident_bytes" in series
+        text = export.format_series(series)
+        assert "store.resident_bytes" in text
+        em.delete()
+
+    def test_missing_required_counter_fails_validation(self):
+        doc = {"traceEvents": [
+            {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "main"}},
+            {"ph": "C", "pid": 1, "tid": 0, "name": "a", "ts": 1.0,
+             "args": {"value": 2}},
+        ]}
+        errs = validate_trace.validate(doc, TRACE_SCHEMA,
+                                       require_counters=("b",))
+        assert any("required counter series" in e for e in errs)
+        # backwards counter timestamps rejected
+        doc["traceEvents"].append(
+            {"ph": "C", "pid": 1, "tid": 0, "name": "a", "ts": 0.5,
+             "args": {"value": 3}})
+        errs = validate_trace.validate(doc, TRACE_SCHEMA)
+        assert any("go backwards" in e for e in errs)
+
+
+class TestStatsSurface:
+    def test_summary_metrics_section(self, metered):
+        em = (Dampr.memory(list(range(50000)))
+              .map(lambda x: (x % 13, 1))
+              .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+              .run(name="surface"))
+        s = em.stats()
+        m = s["metrics"]
+        assert m["counters"]["run.jobs_started"] >= 1
+        assert m["counters"]["store.records"] > 0
+        sm = m["sampler"]
+        assert sm["samples"] >= 1
+        assert "series_drops" in sm
+        # the overhead self-metric: present, sane, tiny for this run
+        assert 0 <= sm["overhead"] < 0.5
+        # writer-pool peak queue depth surfaced in the io section
+        assert "writer_queue_peak" in s["io"]
+        # formatting renders the metrics line
+        assert "sampler overhead" in export.format_summary(s)
+        em.delete()
+
+    def test_writer_queue_peak_under_spill_pressure(self, metered):
+        from dampr_tpu.ops.text import ParseNumbers
+        from dampr_tpu.runner import MTRunner
+
+        path = metered / "nums.txt"
+        with open(path, "w") as f:
+            for i in range(60000):
+                f.write("{}\n".format((i * 2654435761) % (1 << 40)))
+        old_dev = settings.use_device
+        settings.use_device = False
+        try:
+            pipe = (Dampr.text(str(path), chunk_size=64 * 1024)
+                    .custom_mapper(ParseNumbers())
+                    .checkpoint(force=True))
+            runner = MTRunner("queue-peak", pipe.pmer.graph,
+                              memory_budget=1 << 18)
+            out = runner.run([pipe.source])
+            n = sum(len(b) for b in out[0].sorted_blocks())
+            assert n == 60000
+        finally:
+            settings.use_device = old_dev
+        s = runner.run_summary
+        if settings.spill_write_threads > 0:
+            assert s["io"]["writer_queue_peak"] >= 1
+        assert s["store"]["spilled_bytes"] > 0
+        # merge fan-in histogram observed under forced merge pressure
+        assert "merge.kway_streams" in s["metrics"]["histograms"]
+        out[0].delete()
+
+    def test_promtext_render(self, metered):
+        em = Dampr.memory(list(range(4000))).map(lambda x: (x, 1)).run(
+            name="prom")
+        s = em.stats()
+        txt = promtext.render_summary(s)
+        assert "# TYPE dampr_tpu_store_records_total counter" in txt
+        assert 'run="prom"' in txt
+        assert "dampr_tpu_sampler_overhead" in txt
+        # pre-metrics stats files render to empty, not an error
+        assert promtext.render_summary({"run": "old"}) == ""
+        em.delete()
+
+
+class TestStatsCli:
+    def _run_cli(self, argv, monkeypatch):
+        import sys
+
+        from dampr_tpu import cli
+
+        monkeypatch.setattr(sys, "argv", ["dampr-tpu-stats"] + argv)
+        try:
+            cli.stats()
+        except SystemExit as e:
+            return e.code or 0
+        return 0
+
+    def test_series_and_prom_flags(self, metered, monkeypatch, capsys):
+        em = Dampr.memory(list(range(3000))).map(lambda x: (x, 1)).run(
+            name="cliser")
+        spath = em.stats()["stats_file"]
+        em.delete()
+        assert self._run_cli([spath, "--series"], monkeypatch) == 0
+        out = capsys.readouterr().out
+        assert "store.resident_bytes" in out
+        assert self._run_cli([spath, "--prom"], monkeypatch) == 0
+        out = capsys.readouterr().out
+        assert "dampr_tpu_store_records_total" in out
+
+    def test_crashdump_exit_nonzero(self, metered, monkeypatch, capsys):
+        def boom(x):
+            raise RuntimeError("cli-crash")
+
+        with pytest.raises(RuntimeError):
+            Dampr.memory([1, 2, 3]).map(boom).run(name="clicrash")
+        rc = self._run_cli(["clicrash"], monkeypatch)
+        assert rc == 3
+        assert "CRASHED RUN" in capsys.readouterr().err
+
+
+class TestCheckBench:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return str(p)
+
+    def test_flags_20pct_drop(self, tmp_path):
+        fresh = self._write(tmp_path, "fresh.json",
+                            {"metric": "m", "value": 80.0})
+        base = self._write(tmp_path, "base.json",
+                           {"metric": "m", "value": 100.0})
+        rc = check_bench.main([fresh, "--baseline", base,
+                               "--tolerance", "0.1", "--strict"])
+        assert rc == 1
+        # warn-only mode reports but passes
+        assert check_bench.main([fresh, "--baseline", base,
+                                 "--tolerance", "0.1"]) == 0
+
+    def test_passes_within_tolerance_and_improvement(self, tmp_path):
+        base = self._write(tmp_path, "base.json",
+                           {"metric": "m", "value": 100.0})
+        ok = self._write(tmp_path, "ok.json", {"metric": "m", "value": 95.0})
+        up = self._write(tmp_path, "up.json",
+                         {"metric": "m", "value": 140.0})
+        assert check_bench.main([ok, "--baseline", base,
+                                 "--tolerance", "0.1", "--strict"]) == 0
+        assert check_bench.main([up, "--baseline", base,
+                                 "--tolerance", "0.1", "--strict"]) == 0
+
+    def test_best_of_and_wrapped_and_config_only(self, tmp_path):
+        fresh = self._write(tmp_path, "fresh.json",
+                            {"metric": "m", "value": 90.0})
+        wrapped = self._write(
+            tmp_path, "wrapped.json",
+            {"n": 5, "cmd": "x", "parsed": {"metric": "m", "value": 88.0}})
+        config_only = self._write(tmp_path, "cfg.json",
+                                  {"metric": "descriptive text only"})
+        other_metric = self._write(tmp_path, "other.json",
+                                   {"metric": "different", "value": 999.0})
+        report = check_bench.compare(
+            check_bench.load_record(fresh),
+            [(p, check_bench.load_record(p))
+             for p in (wrapped, config_only, other_metric)],
+            tolerance=0.1)
+        assert report["best"] == 88.0  # wrapped counted, others skipped
+        assert report["ok"]
+        assert config_only in report["skipped"]
+        assert other_metric in report["skipped"]
+
+    def test_no_baseline_passes_and_bad_input_errors(self, tmp_path):
+        fresh = self._write(tmp_path, "fresh.json",
+                            {"metric": "m", "value": 1.0})
+        assert check_bench.main([fresh, "--strict"]) == 0
+        bad = self._write(tmp_path, "bad.json", {"metric": "m"})
+        assert check_bench.main([bad, "--strict"]) == 2
+
+
+class TestProgress:
+    def test_render_line_and_stream_ticks(self):
+        import io
+
+        m = Metrics("p")
+        m.counter_add("store.records", 1000)
+        m.counter_add("store.bytes", 4 * 1024 ** 2)
+        buf = io.StringIO()
+        rep = ProgressReporter(
+            m, lambda: {"sid": 1, "n_stages": 3, "kind": "map",
+                        "jobs_total": 8, "jobs_done": 2,
+                        "stage_t0": time.time() - 1.0},
+            interval_ms=50, stream=buf)
+        line = rep.render_line()
+        assert "[stage 1/3 map]" in line and "jobs 2/8" in line
+        assert "eta" in line
+        rep.start()
+        time.sleep(0.3)
+        rep.stop()
+        assert rep.lines >= 2
+        assert "[stage 1/3 map]" in buf.getvalue()
+
+    def test_progress_run_end_to_end(self, metered, monkeypatch):
+        monkeypatch.setattr(settings, "progress", True)
+        monkeypatch.setattr(settings, "progress_interval_ms", 50)
+        em = Dampr.memory(list(range(50000))).map(
+            lambda x: (x % 7, 1)).run(name="prog-e2e")
+        assert not _obs_threads()  # reporter joined at teardown
+        em.delete()
+
+
+class TestRecorderWiring:
+    def test_tracer_mirrors_into_ring(self):
+        t = trace.Tracer("mirror")
+        rec = FlightRecorder("mirror", capacity=8)
+        t.recorder = rec
+        trace.start(t)
+        try:
+            for _ in range(20):
+                with trace.span("fold", "x"):
+                    pass
+        finally:
+            trace.stop(t)
+        assert len(t.events) == 20      # tracer keeps everything
+        assert len(rec) <= 8            # ring stays bounded
+        assert rec.drops >= 12

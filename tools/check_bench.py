@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""CI perf-regression gate: compare a fresh bench JSON against baselines.
+
+Dependency-free (runs in any CI container).  Reads the one-line JSON the
+benches emit (``bench.py`` / ``dampr-tpu-bench`` / ``benchmarks/
+sort_bench.py``) — or a driver-wrapped record with the payload under a
+``parsed`` key — extracts the headline ``value`` (MB/s, higher is
+better), and checks it against the best usable baseline::
+
+    python tools/check_bench.py fresh.json \\
+        --baseline BASELINE.json BENCH_r05.json BENCH_r04.json \\
+        --tolerance 0.25 [--strict] [--metric-key value]
+
+- **Baselines** may be a mix: historical bench records (``BENCH_r*.json``,
+  wrapped or raw) contribute their ``value``; config-only descriptors
+  (the repo's ``BASELINE.json`` carries targets, not measurements) are
+  skipped with a note.  The gate compares against the BEST usable
+  baseline (past best-of is the honest bar; a lucky run must not ratchet
+  the gate above what the code sustains, so pass several historical
+  files and the max wins).
+- **Tolerance** is the allowed fractional drop below that bar (default
+  0.25 — CI boxes are noisy; tighten as variance data accumulates).
+- **Exit code**: 0 on pass or when no usable baseline exists (first run,
+  config-only baselines); on a regression, 1 with ``--strict``, else 0
+  with a loud ``WARN`` line (the warn-only rollout mode).  Malformed
+  input is always an error (2) — a gate that can't read its input must
+  not report success.
+
+Secondary numeric keys shared by fresh and baseline (io_wait_fraction,
+spill MB/s, ...) are reported informationally, never gated.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_record(path):
+    """A bench JSON file -> its payload dict (driver wrappers unwrapped,
+    non-dict payloads rejected)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError("{}: bench record is not a JSON object".format(
+            path))
+    return doc
+
+
+def headline(rec, key="value"):
+    """The gated number, or None when the record has no measurement
+    (config-only baselines)."""
+    v = rec.get(key)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def compare(fresh, baselines, tolerance, key="value"):
+    """Compare one fresh record against (path, record) baselines.
+
+    Returns a report dict: ``ok`` (bool), ``fresh``, ``best`` (None when
+    no usable baseline), ``best_path``, ``drop`` (fractional, negative =
+    improvement), ``skipped`` (unusable baseline paths), ``notes``.
+    """
+    fresh_v = headline(fresh, key)
+    if fresh_v is None:
+        raise ValueError(
+            "fresh bench record has no numeric {!r} field".format(key))
+    metric = fresh.get("metric")
+    best = None
+    best_path = None
+    skipped = []
+    for path, rec in baselines:
+        v = headline(rec, key)
+        if v is None:
+            skipped.append(path)
+            continue
+        bmetric = rec.get("metric")
+        if metric and bmetric and bmetric != metric:
+            skipped.append(path)
+            continue
+        if best is None or v > best:
+            best, best_path = v, path
+    report = {
+        "metric": metric, "fresh": fresh_v, "best": best,
+        "best_path": best_path, "skipped": skipped, "tolerance": tolerance,
+        "drop": None, "ok": True, "notes": [],
+    }
+    if best is None:
+        report["notes"].append(
+            "no usable baseline (no numeric {!r} with a matching metric): "
+            "gate passes vacuously".format(key))
+        return report
+    drop = (best - fresh_v) / best if best > 0 else 0.0
+    report["drop"] = drop
+    report["ok"] = drop <= tolerance
+    return report
+
+
+def _fmt_extra(fresh, baseline_rec):
+    """Informational table of shared secondary numeric keys."""
+    if baseline_rec is None:
+        return []
+    lines = []
+    skip = {"value"}
+    for k in sorted(set(fresh) & set(baseline_rec) - skip):
+        a, b = fresh[k], baseline_rec[k]
+        if (isinstance(a, (int, float)) and isinstance(b, (int, float))
+                and not isinstance(a, bool) and not isinstance(b, bool)):
+            lines.append("  {:<32} fresh {:>12.4g}   baseline {:>12.4g}"
+                         .format(k, float(a), float(b)))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="compare a bench JSON against baseline bench JSONs")
+    ap.add_argument("fresh", help="the just-measured bench JSON")
+    ap.add_argument("--baseline", nargs="+", default=[],
+                    help="baseline bench JSONs (best usable one gates)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed fractional drop below the best baseline "
+                         "(default 0.25)")
+    ap.add_argument("--metric-key", default="value",
+                    help="record key holding the gated number")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regression (default: warn only)")
+    args = ap.parse_args(argv)
+
+    try:
+        fresh = load_record(args.fresh)
+        baselines = [(p, load_record(p)) for p in args.baseline]
+        report = compare(fresh, baselines, args.tolerance,
+                         key=args.metric_key)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("check_bench: ERROR: {}".format(e), file=sys.stderr)
+        return 2
+
+    metric = report["metric"] or args.metric_key
+    print("check_bench: {} fresh={:.4g}".format(metric, report["fresh"]))
+    for p in report["skipped"]:
+        print("check_bench: note: {} has no comparable measurement, "
+              "skipped".format(p))
+    for n in report["notes"]:
+        print("check_bench: note: {}".format(n))
+    if report["best"] is None:
+        print("check_bench: PASS (nothing to gate against)")
+        return 0
+    print("check_bench: best baseline {:.4g} ({})  drop {:+.1%}  "
+          "tolerance {:.0%}".format(report["best"], report["best_path"],
+                                    report["drop"], report["tolerance"]))
+    best_rec = dict(baselines).get(report["best_path"])
+    for line in _fmt_extra(fresh, best_rec):
+        print(line)
+    if report["ok"]:
+        print("check_bench: PASS")
+        return 0
+    msg = ("{} regressed {:.1%} below the best baseline "
+           "({:.4g} -> {:.4g}, tolerance {:.0%})".format(
+               metric, report["drop"], report["best"], report["fresh"],
+               report["tolerance"]))
+    if args.strict:
+        print("check_bench: FAIL")
+        print("check_bench: " + msg, file=sys.stderr)
+        return 1
+    print("check_bench: WARN (non-strict): " + msg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Validate a dampr_tpu trace.json against docs/trace_schema.json.
+"""Validate a dampr_tpu trace.json / crashdump.json against
+docs/trace_schema.json.
 
 Dependency-free (CI and containers without jsonschema): implements the
 JSON-Schema subset the checked-in schema uses — type, required,
@@ -8,17 +9,27 @@ schema prose defers here:
 
 - ``X`` (complete) events carry numeric ``ts`` and ``dur``;
 - ``i`` (instant) events carry numeric ``ts`` and a scope ``s``;
+- ``C`` (counter) events carry numeric ``ts`` and an ``args`` object of
+  numeric series values (the metrics plane's sampled time series);
 - ``M`` (metadata) events are ``process_name``/``thread_name`` records;
-- at least one ``thread_name`` metadata event exists (lanes are named).
+- at least one ``thread_name`` metadata event exists (lanes are named);
+- counter timestamps are non-decreasing per series (the sampler's
+  monotonic-clock contract).
+
+Flight-recorder crash dumps are the same document shape (their
+``otherData.crash`` block is schema-checked when present), so the one
+validator covers both artifacts.
 
 Usage::
 
     python tools/validate_trace.py TRACE.json [--schema docs/trace_schema.json]
                                    [--require-cats codec,fold,spill]
+                                   [--require-counters store.resident_bytes]
 
 ``--require-cats`` additionally asserts each listed span category appears
 on at least one X/i event (the bench smoke job pins the kinds the traced
-workload must produce).
+workload must produce); ``--require-counters`` does the same for counter
+series names on C events.
 """
 
 import argparse
@@ -81,6 +92,7 @@ def _check(instance, schema, path, errors):
 
 def _phase_rules(events, errors):
     named_lanes = 0
+    last_counter_ts = {}  # series name -> last seen ts (monotonic pin)
     for i, ev in enumerate(events):
         ph = ev.get("ph")
         where = "traceEvents[{}]".format(i)
@@ -94,6 +106,25 @@ def _phase_rules(events, errors):
                 errors.append(where + ": i event without numeric ts")
             if ev.get("s") not in ("t", "p", "g"):
                 errors.append(where + ": i event without scope s")
+        elif ph == "C":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                errors.append(where + ": C event without numeric ts")
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args:
+                errors.append(where + ": C event without args payload")
+            elif not all(isinstance(v, (int, float))
+                         and not isinstance(v, bool)
+                         for v in args.values()):
+                errors.append(where + ": C event args must be numeric")
+            name = ev.get("name")
+            if isinstance(ts, (int, float)) and name is not None:
+                prev = last_counter_ts.get(name)
+                if prev is not None and ts < prev:
+                    errors.append(
+                        where + ": counter series {!r} timestamps go "
+                        "backwards ({} < {})".format(name, ts, prev))
+                last_counter_ts[name] = ts
         elif ph == "M":
             if ev.get("name") == "thread_name":
                 named_lanes += 1
@@ -103,13 +134,21 @@ def _phase_rules(events, errors):
         errors.append("no thread_name metadata: lanes are unnamed")
 
 
-def validate(doc, schema, require_cats=()):
+def validate(doc, schema, require_cats=(), require_counters=()):
     """Return a list of error strings (empty = valid)."""
     errors = []
     _check(doc, schema, "$", errors)
     events = doc.get("traceEvents")
     if isinstance(events, list):
         _phase_rules(events, errors)
+        counters = {ev.get("name") for ev in events
+                    if ev.get("ph") == "C"}
+        for want in require_counters:
+            if want not in counters:
+                errors.append(
+                    "required counter series {!r} absent (have: {})"
+                    .format(want,
+                            ", ".join(sorted(c for c in counters if c))))
         cats = {ev.get("cat") for ev in events
                 if ev.get("ph") in ("X", "i")}
         # Closed category set: every span kind the engine emits is
@@ -140,6 +179,9 @@ def main(argv=None):
         "docs", "trace_schema.json"))
     ap.add_argument("--require-cats", default="",
                     help="comma-separated span categories that must appear")
+    ap.add_argument("--require-counters", default="",
+                    help="comma-separated counter series (C-event names) "
+                         "that must appear")
     args = ap.parse_args(argv)
 
     with open(args.trace) as f:
@@ -147,15 +189,21 @@ def main(argv=None):
     with open(args.schema) as f:
         schema = json.load(f)
     cats = [c for c in args.require_cats.split(",") if c]
-    errors = validate(doc, schema, cats)
+    counters = [c for c in args.require_counters.split(",") if c]
+    errors = validate(doc, schema, cats, counters)
     if errors:
         for e in errors:
             print("INVALID: {}".format(e), file=sys.stderr)
         return 1
     n = len(doc["traceEvents"])
-    print("OK: {} events, {} categories".format(
+    n_counter_series = len({ev.get("name") for ev in doc["traceEvents"]
+                            if ev.get("ph") == "C"})
+    crash = (doc.get("otherData") or {}).get("crash")
+    tag = " [crashdump: {}]".format(crash.get("reason")) if crash else ""
+    print("OK: {} events, {} categories, {} counter series{}".format(
         n, len({ev.get("cat") for ev in doc["traceEvents"]
-                if ev.get("cat")})))
+                if ev.get("cat") and ev.get("ph") in ("X", "i")}),
+        n_counter_series, tag))
     return 0
 
 
