@@ -155,6 +155,13 @@ def main():
         "metrics_interval_ms": settings.effective_metrics_interval_ms(),
         "sampler_overhead": ((runner.run_summary or {}).get(
             "metrics", {}).get("sampler", {}).get("overhead")),
+        # Logical plan optimizer (dampr_tpu.plan): constructed vs executed
+        # stage counts — fused-vs-unfused evidence for the baselines
+        # (stages_before == stages_after under DAMPR_TPU_OPTIMIZE=0).
+        "optimize": settings.optimize,
+        "plan_stages_before": (runner.plan_report or {}).get(
+            "stages_before"),
+        "plan_stages_after": (runner.plan_report or {}).get("stages_after"),
         "trace_file": (runner.run_summary or {}).get("trace_file"),
     }))
 

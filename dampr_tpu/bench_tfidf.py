@@ -293,6 +293,13 @@ def main():
         # (None when the plane was off — the default untraced path).
         "sampler_overhead": summary.get("metrics", {}).get(
             "sampler", {}).get("overhead"),
+        # Logical plan optimizer (dampr_tpu.plan, winning warm run):
+        # constructed vs executed stage counts and the rules that fired —
+        # the fused-vs-unfused shape baselines capture (identical counts
+        # under DAMPR_TPU_OPTIMIZE=0).
+        "optimize": _settings.optimize,
+        "plan_stages_before": summary.get("plan", {}).get("stages_before"),
+        "plan_stages_after": summary.get("plan", {}).get("stages_after"),
         "trace_file": summary.get("trace_file"),
         "stats_file": summary.get("stats_file"),
     }))

@@ -18,7 +18,8 @@ canonical workloads run from an installed package without a repo checkout.
   command exit 3 so scripts detect failed runs.
 
 ``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` take ``--progress`` for the live
-in-run status line (``settings.progress``).
+in-run status line (``settings.progress``) and ``--explain`` to print the
+optimized logical plan (dampr_tpu.plan; docs/plan.md) without running.
 """
 
 import argparse
@@ -55,16 +56,23 @@ def wc():
     ap.add_argument("--progress", action="store_true",
                     help="live per-stage status line while the run "
                          "executes (records/s, MB/s, spill backlog, ETA)")
+    ap.add_argument("--explain", action="store_true",
+                    help="print the optimized logical plan (stage fusion, "
+                         "dead stages, adaptive sizing) and exit without "
+                         "running — see docs/plan.md")
     args = ap.parse_args()
     if args.progress:
         _enable_progress()
 
     from . import Dampr
 
-    counts = (Dampr.text(args.path, chunk_size=args.chunk_mb * 1024 ** 2)
-              .flat_map(lambda line: line.split())
-              .fold_by(lambda w: w, binop=operator.add, value=lambda w: 1)
-              .run("wc-cli"))
+    pipe = (Dampr.text(args.path, chunk_size=args.chunk_mb * 1024 ** 2)
+            .flat_map(lambda line: line.split())
+            .fold_by(lambda w: w, binop=operator.add, value=lambda w: 1))
+    if args.explain:
+        print(pipe.explain(name="wc-cli"))
+        return
+    counts = pipe.run("wc-cli")
     for word, count in sorted(counts, key=lambda kv: kv[1],
                               reverse=True)[:20]:
         print("{}: {}".format(word, count))
@@ -82,6 +90,10 @@ def tf_idf():
     ap.add_argument("--progress", action="store_true",
                     help="live per-stage status line while the run "
                          "executes (records/s, MB/s, spill backlog, ETA)")
+    ap.add_argument("--explain", action="store_true",
+                    help="print the optimized logical plan (stage fusion, "
+                         "dead stages, adaptive sizing) and exit without "
+                         "running — see docs/plan.md")
     args = ap.parse_args()
     if args.progress:
         _enable_progress()
@@ -98,7 +110,11 @@ def tf_idf():
         docs.len(),
         lambda d, total: (d[0], d[1], math.log(1 + float(total) / d[1])),
         memory=True)
-    em = idf.sink_tsv(args.out).run("tfidf-cli")
+    pipe = idf.sink_tsv(args.out)
+    if args.explain:
+        print(pipe.explain(name="tfidf-cli"))
+        return
+    em = pipe.run("tfidf-cli")
     print("TSV parts in {}".format(args.out))
     if args.stats:
         _print_stats(em)

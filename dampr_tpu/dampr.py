@@ -4,12 +4,21 @@ Parity surface: reference dampr/dampr.py (977 LoC) — ``Dampr`` entrypoints
 (memory/text/json/read_input/from_dataset, 845-912), ``PMap`` chainable
 collection ops (85-652), ``ARReduce`` associative reduces (654-709),
 ``PReduce`` general reduces (711-766), ``PJoin`` (768-829), ``ValueEmitter``
-(19-51), map fusion (959-967), multi-output ``Dampr.run`` (914-945).
+(19-51), multi-output ``Dampr.run`` (914-945).
 
 Semantics preserved exactly: handles are immutable (every op returns a new
-handle over a copied graph), consecutive per-record ops fuse into one map
-stage, ``a_group_by`` installs a map-side combiner, ``join`` unions graphs
-deduping shared prefixes, results stream back key-sorted.
+handle over a copied graph), ``a_group_by`` installs a map-side combiner,
+``join`` unions graphs deduping shared prefixes, results stream back
+key-sorted.
+
+Stage granularity: every chained call compiles to its own
+:class:`~dampr_tpu.graph.StageNode` — the graph IS the user's logical
+plan, one node per op.  Fusing consecutive per-record ops into one
+executed map stage is the job of the logical plan optimizer
+(:mod:`dampr_tpu.plan`, ``settings.optimize``, on by default), which
+``run()`` invokes before handing the graph to the runner; ``explain()``
+renders the before/after plan.  ``checkpoint()`` is the explicit
+materialization barrier the optimizer never fuses across.
 
 TPU-native difference: ``a_group_by``/``fold_by``/``count``/``sum``/``mean``
 carry :class:`~dampr_tpu.ops.segment.AssocOp` descriptors, so recognized
@@ -23,16 +32,16 @@ import logging
 import random
 import sys
 import threading
-import time
 
-from .base import (AssocFoldReducer, Filter, FlatMap, Inspect, KeyedInnerJoin,
-                   KeyedLeftJoin, KeyedOuterJoin, KeyedReduce, Map, MapAllJoin,
-                   MapCrossJoin, MapKeys, MapValues, Mapper,
+from . import settings
+from .base import (AssocFoldReducer, ComposedMapper, Filter, FlatMap, Inspect,
+                   KeyedInnerJoin, KeyedLeftJoin, KeyedOuterJoin, KeyedReduce,
+                   Map, MapAllJoin, MapCrossJoin, MapKeys, MapValues, Mapper,
                    PartialReduceCombiner, Prefix, Reducer, Rekey, Sample,
                    StreamMapper, StreamReducer, Streamable, Suffix, ValueMap,
-                   _identity, _shared_instance_deepcopy, fuse)
+                   _identity, _shared_instance_deepcopy)
 from .dataset import CatDataset, Chunker
-from .graph import Graph, Source
+from .graph import GMap, Graph, Source
 from .inputs import MemoryInput, PathInput, UrlsInput
 from .ops import segment
 from .runner import MTRunner
@@ -126,13 +135,34 @@ class PBase(object):
                 "resume=True)")
         if name is None:
             name = "dampr/{}".format(random.random())
+        if settings.seed is not None:
+            _reset_sample_rngs()
         runner = self.pmer.runner(name, self.pmer.graph, **kwargs)
+        # The logical plan optimizer (dampr_tpu.plan): rewrites the stage
+        # list — map fusion, combiner hoisting, dead-stage elimination,
+        # stats-driven sizing — before execution.  settings.optimize=False
+        # runs the graph exactly as constructed.  Idempotent: MTRunner.run
+        # re-checks, so direct-runner users get the same treatment.
+        from . import plan as _plan
+
+        _plan.apply_to_runner(runner, [self.source])
         ds = runner.run([self.source])
         em = ValueEmitter(ds[0])
         em.stats = RunStats(
             [s.as_dict() for s in getattr(runner, "stats", [])],
             getattr(runner, "run_summary", None))
         return em
+
+    def explain(self, name=None):
+        """Render this pipeline's logical plan — the constructed stage
+        list, the optimizer's rewrite (fusion decisions, eliminated
+        stages), and the cost layer's adaptive annotations — WITHOUT
+        executing anything.  ``name`` points at a run name whose persisted
+        stats history the adaptive layer would consume (see docs/plan.md).
+        """
+        from . import plan as _plan
+
+        return _plan.explain_text(self.pmer.graph, [self.source], name=name)
 
     def read(self, k=None, **kwargs):
         """Shorthand for run() + read()."""
@@ -183,35 +213,66 @@ class _TopKBlocks(Mapper):
 
 
 class PMap(PBase):
-    """A lazy collection; consecutive per-record ops are queued in ``agg`` and
-    fused into a single map stage at the next checkpoint."""
+    """A lazy collection.  Every chained op lands in the graph as its own
+    stage node immediately; the plan optimizer (:mod:`dampr_tpu.plan`)
+    re-fuses pure per-record chains into single executed map stages at
+    ``run()`` time."""
 
     def __init__(self, source, pmer, agg=None):
         super(PMap, self).__init__(source, pmer)
-        self.agg = [] if agg is None else agg
+        # Vestigial (pre-plan-optimizer API): per-record ops used to queue
+        # here until the next checkpoint; they now land in the graph
+        # immediately, so there are never pending ops.  The attribute is
+        # kept because callers probe `.agg` truthiness to decide whether a
+        # checkpoint is needed before handing the graph to a runner —
+        # but PASSING pending mappers would silently drop them, so fail
+        # loudly instead.
+        assert not agg, (
+            "PMap no longer queues pending mappers; chain ops through the "
+            "DSL (each lands in the graph immediately) instead of passing "
+            "agg")
+        self.agg = []
 
-    def run(self, name=None, **kwargs):
-        if len(self.agg) > 0:
-            return self.checkpoint().run(name, **kwargs)
-        return super(PMap, self).run(name, **kwargs)
-
-    # -- fusion plumbing ---------------------------------------------------
-    def _add_mapper(self, mapper):
+    # -- stage plumbing ----------------------------------------------------
+    def _add_mapper(self, mapper, options=None):
         assert isinstance(mapper, Streamable)
-        return PMap(self.source, self.pmer, self.agg + [mapper])
+        source, pmer = self.pmer._add_mapper([self.source], mapper,
+                                             options=options)
+        return PMap(source, pmer)
 
     def _add_map(self, f):
         return self._add_mapper(Map(f))
 
+    def _materialized_for_reduce(self):
+        """A handle whose source a GReduce may consume directly.  Map-stage
+        outputs carry the hash-routing/sorted-run invariants a reduce
+        depends on by construction (the runner's ``feeds_reduce`` view);
+        taps, sinks, and reduce outputs get an identity copy stage — the
+        re-routing pass the alias provenance gate would force anyway
+        (reduce outputs are registered under the reduce job's pid with
+        whatever keys the reducer emitted)."""
+        for stage in self.pmer.graph.stages:
+            if stage.output == self.source:
+                if isinstance(stage, GMap):
+                    return self
+                break
+        source, pmer = self.pmer._add_mapper([self.source], Map(_identity))
+        return PMap(source, pmer)
+
     def checkpoint(self, force=False, combiner=None, options=None):
-        """Fuse queued maps into a materialized stage boundary; shared
-        sub-graphs are then computed once (dedup happens in Graph.union)."""
-        if len(self.agg) > 0 or force:
-            aggs = [Map(_identity)] if len(self.agg) == 0 else self.agg[:]
-            source, pmer = self.pmer._add_mapper(
-                [self.source], fuse(aggs), combiner=combiner, options=options)
-            return PMap(source, pmer)
-        return self
+        """Install an EXPLICIT materialization barrier: the stage's output
+        is computed and pinned at this boundary, and the plan optimizer
+        never fuses across it (``options["barrier"]``).  Use it to share a
+        sub-graph between branches (dedup happens in Graph.union) or to
+        force a spill/merge boundary; a redundant barrier over an
+        already-materialized input aliases at run time instead of copying.
+        ``force`` is accepted for API compatibility (every checkpoint now
+        materializes)."""
+        opts = dict(options) if options else {}
+        opts.setdefault("barrier", True)
+        source, pmer = self.pmer._add_mapper(
+            [self.source], Map(_identity), combiner=combiner, options=opts)
+        return PMap(source, pmer)
 
     # -- per-record ops ----------------------------------------------------
     # Each queues a typed RecordOp (base.py): the engine executes chains of
@@ -263,13 +324,13 @@ class PMap(PBase):
     def group_by(self, key, vf=None):
         """General (non-associative) grouping; returns PReduce.  ``vf``
         defaults to the identity (records keep their value)."""
-        pm = self._add_mapper(Rekey(key, vf)).checkpoint()
+        pm = self._add_mapper(Rekey(key, vf))
         return PReduce(pm.source, pm.pmer)
 
     def a_group_by(self, key, vf=None):
         """Associative grouping: enables map-side combining before the
-        shuffle (no checkpoint until the binop is known).  ``vf`` defaults
-        to the identity."""
+        shuffle (the combiner stage lands when the binop is known).
+        ``vf`` defaults to the identity."""
         pm = self._add_mapper(Rekey(key, vf))
         return ARReduce(pm)
 
@@ -289,8 +350,11 @@ class PMap(PBase):
         return ARReduce(self).reduce(binop, **options)
 
     def sort_by(self, key, **options):
-        """Globally sort values by a key function (results merge key-sorted)."""
-        return self._add_mapper(Rekey(key)).checkpoint(options=options)
+        """Globally sort values by a key function (results merge key-sorted).
+        The re-key stage is a plain map node — a sort_by feeding further
+        per-record ops fuses with them (mid-pipeline record order is not
+        part of the contract; only the FINAL read merges key-sorted)."""
+        return self._add_mapper(Rekey(key), options=options or None)
 
     def count(self, key=lambda x: x, **options):
         """Count values per key — compiles to a device segment-sum."""
@@ -319,24 +383,17 @@ class PMap(PBase):
                 .map(_avg))
 
     def len(self):
-        """Count all items in the collection.  With no pending per-record
-        ops the map side never touches records: text chunks count owned
-        newlines, block-backed chunks sum block lengths (CountRecords).
-        Pending ops force one streamed pass — the count is of TRANSFORMED
-        records (a flat_map changes it), so there is nothing to vectorize."""
-        def _count_stream(values):
-            return ((1, sum(1 for _ in values)),)
-
+        """Count all items in the collection.  The map side never touches
+        records: text chunks count owned newlines, block-backed chunks sum
+        block lengths (CountRecords).  Valid at ANY point in a chain —
+        the handle's source always refers to the realized record stream
+        (ops are stage nodes, never pending)."""
         def _sum_counts(groups):
             totals = [c for _k, cs in groups for c in cs]
             return ((1, sum(totals)),) if totals else ()
 
-        if not self.agg:
-            from .ops.text import CountRecords
-            head = self.custom_mapper(CountRecords())
-        else:
-            head = self.partition_map(_count_stream)
-        return (head
+        from .ops.text import CountRecords
+        return (self.custom_mapper(CountRecords())
                 .partition_reduce(_sum_counts)
                 .map(lambda x: x[1]))
 
@@ -361,7 +418,7 @@ class PMap(PBase):
             cands = (p for _one, ps in groups for p in ps)
             return ((p[1], 1) for p in heapq.nlargest(k, cands))
 
-        if vf is None and not self.agg:
+        if vf is None:
             head = self.custom_mapper(_TopKBlocks(k))
         else:
             head = self.partition_map(_cands)
@@ -369,20 +426,22 @@ class PMap(PBase):
 
     # -- custom operators --------------------------------------------------
     def custom_mapper(self, mapper, name=None, **options):
-        """Install a user Mapper instance (low-level; does not fuse)."""
-        if isinstance(mapper, Streamable):
-            return self._add_mapper(mapper)
+        """Install a user Mapper instance as its own stage (low-level).
+        A bare Streamable (no ``map``) is wrapped so the stage can drive
+        it over its input dataset."""
+        if isinstance(mapper, Streamable) and not isinstance(mapper, Mapper):
+            mapper = ComposedMapper(Map(_identity), mapper)
         assert isinstance(mapper, Mapper)
-        me = self.checkpoint()
-        source, pmer = me.pmer._add_mapper([me.source], mapper, options=options)
+        source, pmer = self.pmer._add_mapper([self.source], mapper,
+                                             options=options or None)
         return PMap(source, pmer)
 
     def custom_reducer(self, reducer, name=None, **options):
         """Install a user Reducer instance (low-level)."""
         assert isinstance(reducer, Reducer)
-        me = self.checkpoint(force=True)
+        me = self._materialized_for_reduce()
         source, pmer = me.pmer._add_reducer([me.source], reducer,
-                                            options=options)
+                                            options=options or None)
         return PMap(source, pmer)
 
     def partition_map(self, f, **options):
@@ -398,9 +457,9 @@ class PMap(PBase):
     def join(self, other):
         """Co-partitioned join with another collection; returns PJoin."""
         assert isinstance(other, PBase)
-        me = self.checkpoint(True)
+        me = self._materialized_for_reduce()
         if isinstance(other, PMap):
-            other = other.checkpoint(True)
+            other = other._materialized_for_reduce()
         pmer = Dampr(me.pmer.graph.union(other.pmer.graph))
         return PJoin(me.source, pmer, other.source)
 
@@ -415,11 +474,9 @@ class PMap(PBase):
         def _cross(k1, v1, k2, v2):
             yield k1, cross(v2, v1)
 
-        me = self.checkpoint()
-        other = other.checkpoint()
-        pmer = Dampr(me.pmer.graph.union(other.pmer.graph))
+        pmer = Dampr(self.pmer.graph.union(other.pmer.graph))
         source, pmer = pmer._add_mapper(
-            [other.source, me.source], MapCrossJoin(_cross, cache=memory),
+            [other.source, self.source], MapCrossJoin(_cross, cache=memory),
             combiner=None, options=options)
         return PMap(source, pmer)
 
@@ -435,11 +492,9 @@ class PMap(PBase):
         def _aggregate(d):
             return agg(v for _k, v in d)
 
-        me = self.checkpoint()
-        other = other.checkpoint()
-        pmer = Dampr(me.pmer.graph.union(other.pmer.graph))
+        pmer = Dampr(self.pmer.graph.union(other.pmer.graph))
         source, pmer = pmer._add_mapper(
-            [other.source, me.source], MapAllJoin(_cross, _aggregate),
+            [other.source, self.source], MapAllJoin(_cross, _aggregate),
             combiner=None, options=options)
         return PMap(source, pmer)
 
@@ -451,9 +506,11 @@ class PMap(PBase):
 
     def sink(self, path):
         """Write each value as a text line into part-files under ``path``
-        (durable — exempt from cleanup)."""
-        aggs = [Map(_identity)] if len(self.agg) == 0 else self.agg[:]
-        source, pmer = self.pmer._add_sink([self.source], fuse(aggs),
+        (durable — exempt from cleanup).  The sink node starts as an
+        identity sinker; the plan optimizer composes any pure record
+        chain feeding it into the sinker, so transformed records stream
+        straight to disk without an intermediate materialization."""
+        source, pmer = self.pmer._add_sink([self.source], Map(_identity),
                                            path=path, options=None)
         return PMap(source, pmer)
 
@@ -477,13 +534,20 @@ class ARReduce(object):
 
     def reduce(self, binop, reduce_buffer=1000, **options):
         """Reduce groups with an associative binop.  ``reduce_buffer`` is
-        accepted for API parity; block-size accounting replaces it."""
+        accepted for API parity; block-size accounting replaces it.
+
+        Plants an identity stage carrying the map-side combiner (the
+        local-combine half of the shuffle) ahead of the final-fold
+        reduce; the plan optimizer hoists that combiner into the
+        producing map stage, so optimized runs fold map-side inside the
+        producer's own jobs."""
         op = segment.as_assoc_op(binop)
         options.update({"binop": op, "reduce_buffer": reduce_buffer})
-        pm = self.pmap.checkpoint(
-            True, combiner=PartialReduceCombiner(op), options=options)
-        new_source, pmer = pm.pmer._add_reducer(
-            [pm.source], AssocFoldReducer(op), options=options)
+        source, pmer = self.pmap.pmer._add_mapper(
+            [self.pmap.source], Map(_identity),
+            combiner=PartialReduceCombiner(op), options=options)
+        new_source, pmer = pmer._add_reducer(
+            [source], AssocFoldReducer(op), options=options)
         return PMap(new_source, pmer)
 
     def first(self, **options):
@@ -522,7 +586,7 @@ class PReduce(PBase):
         """Join grouped data with another collection."""
         assert isinstance(other, PBase)
         if isinstance(other, PMap):
-            other = other.checkpoint(True)
+            other = other._materialized_for_reduce()
         pmer = Dampr(self.pmer.graph.union(other.pmer.graph))
         return PJoin(self.source, pmer, other.source)
 
@@ -542,6 +606,12 @@ class PJoin(PBase):
 
     def run(self, name=None, **kwargs):
         return self.reduce(lambda l, r: (list(l), list(r))).run(name, **kwargs)
+
+    def explain(self, name=None):
+        # A bare PJoin runs through the default pairing reduce; explain
+        # the plan that run() would actually execute.
+        return self.reduce(
+            lambda l, r: (list(l), list(r))).explain(name=name)
 
     def reduce(self, aggregate, many=False):
         """Inner join: ``aggregate(left_iter, right_iter)`` per matched key;
@@ -630,9 +700,7 @@ class Dampr(object):
         graph = None
         pmer = None
         for i, pmer in enumerate(pmers):
-            if isinstance(pmer, PMap):
-                pmer = pmer.checkpoint()
-            elif isinstance(pmer, PJoin):
+            if isinstance(pmer, PJoin):
                 pmer = pmer.reduce(lambda l, r: (list(l), list(r)))
             graph = pmer.pmer.graph if i == 0 else pmer.pmer.graph.union(graph)
             sources.append(pmer.source)
@@ -642,7 +710,12 @@ class Dampr(object):
                 "resume=True requires a stable run name: Dampr.run(..., "
                 "name=..., resume=True)")
         name = kwargs.pop("name", "dampr/{}".format(random.random()))
+        if settings.seed is not None:
+            _reset_sample_rngs()
         runner = pmer.pmer.runner(name, graph, **kwargs)
+        from . import plan as _plan
+
+        _plan.apply_to_runner(runner, sources)
         ds = runner.run(sources)
         stats = RunStats([s.as_dict() for s in getattr(runner, "stats", [])],
                          getattr(runner, "run_summary", None))
@@ -669,15 +742,55 @@ class Dampr(object):
 
 # Per-thread RNG for sample(): jobs run on threads, and a shared Random would
 # serialize them on its lock and interleave streams nondeterministically.
+#
+# Seeding (settings.seed, satellite of the plan-optimizer work): with a
+# seed set, each thread's RNG derives deterministically from
+# (seed, per-run thread index) — re-derived at every run start via
+# _reset_sample_rngs() — so sampled pipelines reproduce exactly whenever
+# job->thread assignment is deterministic: serial runs (max_processes=1,
+# or single-job stages, where jobs execute on the stage-walk thread)
+# always are.  Parallel runs get deterministic per-thread STREAMS but a
+# nondeterministic job->thread mapping, so only the distribution is
+# pinned — the documented limit (docs/plan.md).  Default (seed=None)
+# keeps the historical time-seeded behavior.
 _RAND_LOCAL = threading.local()
+_RAND_LOCK = threading.Lock()
+_RAND_STATE = {"epoch": 0, "next_index": None}
+
+
+def _reset_sample_rngs():
+    """Start a fresh deterministic RNG generation (called at run start
+    when settings.seed is set): every thread re-seeds from
+    (seed, index-within-run) at its next draw."""
+    with _RAND_LOCK:
+        _RAND_STATE["epoch"] += 1
+        _RAND_STATE["next_index"] = itertools.count()
 
 
 def _get_rand():
-    r = getattr(_RAND_LOCAL, "rand", None)
-    if r is None:
-        r = random.Random(time.time() + threading.get_ident())
-        _RAND_LOCAL.rand = r
-    return r
+    seed = settings.seed
+    st = _RAND_LOCAL
+    if seed is None:
+        r = getattr(st, "rand", None)
+        if r is None or getattr(st, "seeded", False):
+            # Random() seeds from os.urandom: always distinct per thread.
+            # (The old time.time()+thread_ident seed was quantized to
+            # ~16 ms steps by float64 at pthread-address magnitudes, so a
+            # recycled ident within that window REPLAYED the stream.)
+            r = random.Random()
+            st.rand, st.seeded = r, False
+        return r
+    epoch = _RAND_STATE["epoch"]
+    if (getattr(st, "epoch", None) != epoch
+            or not getattr(st, "seeded", False)):
+        with _RAND_LOCK:
+            counter = _RAND_STATE["next_index"]
+            if counter is None:  # seeded draw before any run: index 0 et seq
+                counter = _RAND_STATE["next_index"] = itertools.count()
+            idx = next(counter)
+        st.rand = random.Random(seed * 1000003 + idx * 7919)
+        st.epoch, st.seeded = epoch, True
+    return st.rand
 
 
 def setup_logging(debug=False):

@@ -10,6 +10,11 @@ These semantics are engine-independent and proven by the reference conformance t
 so they transfer conceptually unchanged; the implementation below is written fresh.
 The execution engine that consumes this graph is completely different (see
 runner.py: stages lower to JAX programs instead of forked workers).
+
+The constructed list is the LOGICAL plan — one node per chained DSL call.
+Before execution the plan optimizer (:mod:`dampr_tpu.plan`) rewrites it
+(map fusion, combiner hoisting, dead-stage elimination, adaptive sizing);
+with ``settings.optimize`` off the runner executes this list literally.
 """
 
 import itertools

@@ -230,6 +230,22 @@ def format_summary(summary):
                     _mb(st.get("bytes_out", 0)),
                     _mb(st.get("spill_bytes", 0)),
                     "{:.2f}".format(st.get("seconds", 0.0))))
+    plan = summary.get("plan") or {}
+    if plan.get("enabled"):
+        fired = {k: v for k, v in sorted((plan.get("rules") or {}).items())
+                 if v}
+        line = "plan: {} -> {} stages".format(
+            plan.get("stages_before", "?"), plan.get("stages_after", "?"))
+        if fired:
+            line += "  ({})".format(", ".join(
+                "{}={}".format(k, v) for k, v in fired.items()))
+        ad = plan.get("adaptive") or {}
+        if ad.get("applied"):
+            line += "  · adaptive: {} change(s)".format(len(
+                ad.get("changes", ())))
+        add(line)
+    elif plan:
+        add("plan: optimizer off (graph executed as constructed)")
     store = summary.get("store", {})
     add("")
     add("spill: {} blocks / {}  ·  merge generations: {} ({})".format(

@@ -1,0 +1,97 @@
+"""Logical plan optimizer: the layer between graph construction and the
+runner.
+
+The DSL (:mod:`dampr_tpu.dampr`) compiles every chained call into its own
+:class:`~dampr_tpu.graph.StageNode` — ``construction order is the
+schedule`` — so an unoptimized ``memory(xs).map(f).map_values(g).filter(h)
+.fold_by(k, op)`` would pay a full materialize boundary per call.  This
+package rewrites the stage list before execution:
+
+- :mod:`.ir` — plan-level views over the :class:`~dampr_tpu.graph.Graph`:
+  consumer maps, mapper-chain flattening/composition, barrier detection,
+  structural signatures (the idempotence witness).
+- :mod:`.passes` — the pass pipeline: **map fusion** (chains of pure
+  per-record ``GMap`` stages whose intermediate Source has a single
+  consumer collapse into one composed mapper, preserving the tail's
+  combiner/shuffler), **combiner hoisting** (an identity stage that only
+  carries a map-side combiner folds into its producer), **sink fusion**
+  (pure record chains compose into the sinker), and **dead-stage
+  elimination** (stages unreachable from any requested output or sink are
+  dropped).
+- :mod:`.cost` — stats-driven adaptation: prior-run ``stats.json``
+  summaries (per-stage records/bytes) size the run's partition count and
+  per-stage block batch sizes, with safe static defaults when no history
+  exists or the plan shape changed.
+- :mod:`.explain` — the ``PBase.explain()`` surface: renders the
+  before/after plan with fusion decisions and cost annotations.
+
+Every rewrite is value-semantic: shared ``StageNode`` objects are never
+mutated (handles stay freely shareable); changed stages are fresh nodes.
+
+Wiring: ``dampr.py`` ``run()`` and ``MTRunner.run()`` both call
+:func:`apply_to_runner` (idempotent — first caller wins), gated by
+``settings.optimize`` (env ``DAMPR_TPU_OPTIMIZE``) with per-rule kill
+switches (``settings.plan_fuse`` / ``plan_fuse_sinks`` / ``plan_dead`` /
+``plan_adapt``).  The runner emits a ``plan`` trace span and a ``plan``
+section in ``em.stats()`` describing stages before/after and the rules
+that fired.  See ``docs/plan.md``.
+"""
+
+import time
+
+from .. import settings
+from . import cost, explain, ir, passes
+from .explain import explain_text
+from .ir import graph_signature
+from .passes import optimize
+
+__all__ = ["optimize", "apply_to_runner", "explain_text", "graph_signature",
+           "ir", "passes", "cost", "explain"]
+
+
+def empty_report(graph, enabled):
+    n = ir.executed_stage_count(graph)
+    return {
+        "enabled": enabled,
+        "stages_before": n,
+        "stages_after": n,
+        "rules": {"fuse_maps": 0, "hoist_combiners": 0, "fuse_sinks": 0,
+                  "dead_stages": 0},
+        "fused": [],
+        "dead": [],
+        "adaptive": {"applied": False, "reason": "disabled"},
+        "seconds": 0.0,
+    }
+
+
+def apply_to_runner(runner, outputs):
+    """Optimize ``runner.graph`` in place for the requested ``outputs`` and
+    attach the plan report as ``runner.plan_report``.
+
+    Idempotent: a runner that already carries a report is left alone, so
+    the DSL entry points and ``MTRunner.run`` can both invoke it without
+    double-rewriting.  Duck-typed (needs ``.graph``; everything else is
+    ``getattr`` with defaults) so custom runner classes keep working.
+    Returns the report (or None when the runner has no graph).
+    """
+    if getattr(runner, "plan_report", None) is not None:
+        return runner.plan_report
+    graph = getattr(runner, "graph", None)
+    if graph is None or not hasattr(graph, "stages"):
+        return None
+    t0 = time.perf_counter()
+    if not settings.optimize:
+        report = empty_report(graph, enabled=False)
+    else:
+        graph, report = optimize(graph, outputs)
+        runner.graph = graph
+        cost.adapt(runner, graph, report)
+    # Shape records ride into stats.json so the NEXT run's cost layer can
+    # match its plan against this run's measurements.
+    report["stage_shapes"] = ir.stage_shapes(getattr(runner, "graph", graph))
+    report["seconds"] = round(time.perf_counter() - t0, 6)
+    try:
+        runner.plan_report = report
+    except AttributeError:
+        pass
+    return report

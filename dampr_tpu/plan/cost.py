@@ -1,0 +1,151 @@
+"""Stats-driven adaptive execution.
+
+A prior run under the same name leaves a ``stats.json`` summary (written
+by the obs layer for traced runs) carrying per-stage records/bytes in and
+out plus the plan's stage shapes.  When the CURRENT optimized plan has the
+same shape sequence, those measurements size this run:
+
+- **partition count**: the run's ``n_partitions`` is re-derived from the
+  largest observed reduce input (``plan_partition_bytes`` per partition,
+  clamped) — tiny workloads stop paying 64 partitions' worth of fixed
+  per-partition numpy cost, huge ones fan out wider.  Skipped when the
+  caller pinned ``n_partitions`` explicitly or the run is resumable
+  (changing the partition count would invalidate every checkpoint).
+- **block batch size**: map stages whose observed bytes/record is large
+  get a per-stage ``batch_size`` option so blocks target
+  ``plan_block_bytes`` instead of ``settings.batch_size`` records of
+  unknown width (bounds per-block memory on fat records).
+- **reducer job width**: reduce stages whose observed input was tiny run
+  their partition jobs on one worker (``n_reducers=1``) — pool fan-out
+  costs more than it buys under ``small_stage_bytes``.
+
+No history, a shape mismatch, or ``settings.plan_adapt`` off -> static
+defaults, untouched.  Every decision lands in the plan report's
+``adaptive`` section (visible via ``explain()`` and ``em.stats()``).
+"""
+
+import logging
+
+from .. import settings
+from ..graph import GMap, GReduce
+from . import ir
+
+log = logging.getLogger("dampr_tpu.plan.cost")
+
+
+def load_history(run_name):
+    """The prior run's stats.json summary for this run name, or None.
+    Never raises: adaptation is best-effort by design."""
+    if not run_name:
+        return None
+    try:
+        from ..obs import export
+
+        summary, _path = export.load_stats(run_name)
+        return summary
+    except Exception:
+        log.debug("stats history unreadable for %r", run_name, exc_info=True)
+        return None
+
+
+def _clamped_partitions(reduce_bytes):
+    want = max(1, -(-int(reduce_bytes) // settings.plan_partition_bytes))
+    floor = max(4, min(settings.max_processes, settings.partitions))
+    ceil_ = max(settings.partitions, 4 * settings.partitions)
+    return max(floor, min(want, ceil_))
+
+
+def _batch_for(rec_bytes):
+    """Records per block so a block targets plan_block_bytes: the largest
+    power of two at or under the target, floored at 16 so degenerate
+    histories (multi-MB records) still batch a handful at a time instead
+    of overshooting the byte bound by orders of magnitude."""
+    if rec_bytes <= 0:
+        return None
+    want = max(16, int(settings.plan_block_bytes // rec_bytes))
+    b = 16
+    while b * 2 <= want:
+        b *= 2
+    return b
+
+
+def adapt(runner, graph, report):
+    """Apply history-driven sizing to ``runner`` (n_partitions) and
+    ``runner.graph`` (per-stage options).  Mutates nothing shared: stages
+    that gain options are fresh clones."""
+    info = {"applied": False, "reason": None, "history": None, "changes": []}
+    report["adaptive"] = info
+    if not settings.plan_adapt:
+        info["reason"] = "disabled"
+        return
+    if getattr(runner, "resume", False):
+        # Checkpoint fingerprints are salted with the partition count and
+        # hash per-stage options: re-sizing would orphan every checkpoint.
+        info["reason"] = "resumable-run"
+        return
+    hist = load_history(getattr(runner, "name", None))
+    if hist is None:
+        info["reason"] = "no-history"
+        return
+    shapes_prev = (hist.get("plan") or {}).get("stage_shapes") or []
+    shapes_now = ir.stage_shapes(graph)
+    if ([s.get("shape") for s in shapes_prev]
+            != [s["shape"] for s in shapes_now]):
+        info["reason"] = "shape-mismatch"
+        return
+    info["history"] = hist.get("stats_file") or hist.get("run")
+    by_sid = {s.get("stage"): s for s in hist.get("stages", [])}
+
+    # -- run-level partition count ------------------------------------------
+    reduce_bytes = 0
+    for i, stage in enumerate(graph.stages):
+        if isinstance(stage, GReduce):
+            st = by_sid.get(i) or {}
+            reduce_bytes = max(reduce_bytes, st.get("bytes_in") or 0)
+    if (reduce_bytes > 0
+            and not getattr(runner, "_explicit_partitions", True)):
+        want = _clamped_partitions(reduce_bytes)
+        if want != runner.n_partitions:
+            info["changes"].append({
+                "what": "n_partitions", "from": runner.n_partitions,
+                "to": want, "reduce_bytes_in": reduce_bytes})
+            runner.n_partitions = want
+
+    # -- per-stage options ---------------------------------------------------
+    new_stages = None
+    for i, stage in enumerate(graph.stages):
+        st = by_sid.get(i) or {}
+        opts = None
+        if (isinstance(stage, GMap)
+                and "batch_size" not in (stage.options or {})):
+            recs, nbytes = st.get("records_out") or 0, st.get("bytes_out") or 0
+            if recs and nbytes:
+                batch = _batch_for(nbytes / float(recs))
+                if batch and batch < settings.batch_size:
+                    opts = dict(stage.options or {})
+                    opts["batch_size"] = batch
+                    info["changes"].append({
+                        "what": "batch_size", "stage": i, "to": batch,
+                        "record_bytes": round(nbytes / float(recs), 1)})
+        elif (isinstance(stage, GReduce)
+                and "n_reducers" not in (stage.options or {})):
+            nbytes = st.get("bytes_in") or 0
+            if 0 < nbytes <= settings.small_stage_bytes:
+                opts = dict(stage.options or {})
+                opts["n_reducers"] = 1
+                info["changes"].append({
+                    "what": "n_reducers", "stage": i, "to": 1,
+                    "bytes_in": nbytes})
+        if opts is not None:
+            if new_stages is None:
+                new_stages = list(graph.stages)
+            new_stages[i] = ir.clone_with_options(stage, opts)
+    if new_stages is not None:
+        runner.graph = ir.rebuilt(new_stages)
+    if info["changes"]:
+        info["applied"] = True
+        report["rules"]["adaptive"] = len(info["changes"])
+        log.info("plan: adaptive sizing applied %d change(s) from %s",
+                 len(info["changes"]), info["history"])
+    else:
+        info["reason"] = "within-defaults"

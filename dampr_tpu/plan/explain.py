@@ -1,0 +1,79 @@
+"""Render a pipeline's logical plan: before/after stage lists, the fusion
+decisions, and the cost layer's adaptive inputs.
+
+Surfaced as ``PBase.explain()`` and the ``--explain`` flag on the
+``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` CLIs.  Pure rendering — running
+``explain()`` never executes the pipeline and never mutates the handle's
+graph (``optimize`` is value-semantic).
+"""
+
+from .. import settings
+from ..graph import GInput
+from . import cost, ir, passes
+
+
+def _stage_lines(graph, indent="  "):
+    pos = {s.output: i for i, s in enumerate(graph.stages)}
+    lines = []
+    for i, stage in enumerate(graph.stages):
+        srcs = ", ".join("s{}".format(pos.get(s, "?")) for s in stage.inputs)
+        arrow = " <- {}".format(srcs) if srcs else ""
+        tag = "" if not isinstance(stage, GInput) else "  (free)"
+        lines.append("{}s{}: {}{}{}".format(
+            indent, i, ir.describe_stage(stage), arrow, tag))
+    return lines
+
+
+def explain_text(graph, outputs, name=None):
+    """The plan report as display text.  ``name`` (a run name) pulls that
+    run's stats history so the adaptive annotations show what the cost
+    layer WOULD use."""
+    lines = []
+    n_before = ir.executed_stage_count(graph)
+    lines.append("== logical plan ({} stages, {} executed) =="
+                 .format(len(graph.stages), n_before))
+    lines.extend(_stage_lines(graph))
+    if not settings.optimize:
+        lines.append("optimizer OFF (settings.optimize / "
+                     "DAMPR_TPU_OPTIMIZE=0): the plan above executes as-is")
+        return "\n".join(lines)
+    optimized, report = passes.optimize(graph, outputs)
+    lines.append("== optimized plan ({} executed) =="
+                 .format(report["stages_after"]))
+    lines.extend(_stage_lines(optimized))
+    fired = {k: v for k, v in sorted(report["rules"].items()) if v}
+    lines.append("rules fired: {}".format(
+        ", ".join("{}={}".format(k, v) for k, v in fired.items())
+        if fired else "none (plan already minimal)"))
+    for f in report["fused"]:
+        lines.append("  {}: {}  =>  {}".format(
+            f["rule"], "  +  ".join(f["members"]), f["into"]))
+    for d in report["dead"]:
+        lines.append("  dead: {}".format(d))
+    # Adaptive annotations (best-effort; needs a prior traced run).
+    if not settings.plan_adapt:
+        lines.append("adaptive: off (settings.plan_adapt)")
+    else:
+        hist = cost.load_history(name) if name else None
+        if hist is None:
+            lines.append("adaptive: no history{} — static defaults "
+                         "(partitions={}, batch_size={})".format(
+                             " for run {!r}".format(name) if name else "",
+                             settings.partitions, settings.batch_size))
+        else:
+            shapes_prev = (hist.get("plan") or {}).get("stage_shapes") or []
+            shapes_now = ir.stage_shapes(optimized)
+            if ([s.get("shape") for s in shapes_prev]
+                    != [s["shape"] for s in shapes_now]):
+                lines.append("adaptive: history shape mismatch — static "
+                             "defaults")
+            else:
+                lines.append("adaptive: history {} ({} stages measured)"
+                             .format(hist.get("stats_file") or name,
+                                     len(hist.get("stages", []))))
+                for st in hist.get("stages", []):
+                    lines.append(
+                        "    s{}: {}  {} rec / {} B out".format(
+                            st.get("stage"), st.get("kind"),
+                            st.get("records_out"), st.get("bytes_out")))
+    return "\n".join(lines)

@@ -1,0 +1,245 @@
+"""Plan-level views over the logical :class:`~dampr_tpu.graph.Graph`.
+
+The graph layer stays a dumb ordered stage list (its value semantics are
+what make handles shareable); everything the optimizer needs to reason
+about it — who consumes which Source, what a mapper chain is made of,
+which stages are rewrite barriers — lives here as pure functions, so the
+passes in :mod:`.passes` never poke at node internals directly.
+"""
+
+from .. import base
+from ..graph import GInput, GMap, GReduce, GSink, Graph
+
+#: Record ops whose presence makes a stage a fusion barrier.  ``Sample``
+#: draws from a per-thread RNG in stream order, so moving it across a
+#: materialization boundary changes which records each RNG stream sees
+#: (seeded runs must stay reproducible across optimize on/off);
+#: ``Inspect`` is the user asking to SEE the records at that exact point.
+BARRIER_OPS = (base.Sample, base.Inspect)
+
+
+# -- mapper chains -----------------------------------------------------------
+
+def flatten_mapper(m):
+    """A (possibly fused) mapper -> its leaf parts in stream order."""
+    if type(m) in (base.ComposedMapper, base.ComposedStreamable):
+        return flatten_mapper(m.left) + flatten_mapper(m.right)
+    return [m]
+
+
+def _is_identity_leaf(p):
+    return type(p) is base.Map and p.mapper is base._identity
+
+
+def is_identity_mapper(m):
+    """True when the mapper chain is pure identity (a checkpoint head)."""
+    return all(_is_identity_leaf(p) for p in flatten_mapper(m))
+
+
+def is_record_chain(m):
+    """Fusable mapper: a pure per-record chain (Map / typed RecordOps,
+    composed) with no barrier ops.  Anything with per-chunk or
+    whole-partition semantics (BlockMapper lifecycle, StreamMapper,
+    map-side joins) transforms at a granularity fusion would change."""
+    if not base.is_pure_record_stream(m):
+        return False
+    return not any(isinstance(p, BARRIER_OPS) for p in flatten_mapper(m))
+
+
+def compose_mappers(*mappers):
+    """Compose mapper chains into one fused mapper, dropping identity
+    leaves (they contribute nothing to the stream)."""
+    parts = []
+    for m in mappers:
+        parts.extend(p for p in flatten_mapper(m) if not _is_identity_leaf(p))
+    if not parts:
+        return base.Map(base._identity)
+    return base.fuse(parts)
+
+
+# -- stage predicates --------------------------------------------------------
+
+def has_barrier_ops(stage):
+    """Does the stage's mapper chain contain a granularity-sensitive op
+    (Sample/Inspect)?  Such stages neither absorb their producer nor
+    dissolve into their consumer: fusing in EITHER direction changes the
+    record grouping their op observes (a sampler's per-thread RNG
+    streams, an inspect's print points)."""
+    m = getattr(stage, "mapper", None)
+    return m is not None and any(isinstance(p, BARRIER_OPS)
+                                 for p in flatten_mapper(m))
+
+
+def stage_is_barrier(stage):
+    """Must this stage's OUTPUT stay materialized exactly as constructed?
+
+    Explicit user checkpoints carry ``options["barrier"]``; ``cached()``
+    pins carry ``memory``; Sample/Inspect chains are barriers by op type.
+    A barrier stage never dissolves into its consumer — the checkpoint
+    boundary the user asked for survives — but a plain checkpoint/cached
+    tail may still ABSORB its producer: that removes the producer's
+    materialization, not the checkpoint's own.
+    """
+    opts = getattr(stage, "options", None) or {}
+    if opts.get("barrier") or opts.get("memory"):
+        return True
+    return has_barrier_ops(stage)
+
+
+def has_combiner(stage):
+    return (getattr(stage, "combiner", None) is not None
+            or "binop" in (getattr(stage, "options", None) or {}))
+
+
+def merge_options(head_opts, tail_opts):
+    """Fused-stage options: the tail's semantic options win (binop,
+    n_reducers, the shuffle shape belongs to the tail); ``n_maps`` takes
+    the most restrictive of the two (a stage that asked to serialize
+    stays serialized when fused — same rule as runtime scan sharing)."""
+    out = dict(head_opts or {})
+    out.update(tail_opts or {})
+    if head_opts and tail_opts and "n_maps" in head_opts \
+            and "n_maps" in tail_opts:
+        out["n_maps"] = min(head_opts["n_maps"], tail_opts["n_maps"])
+    return out
+
+
+# -- graph views -------------------------------------------------------------
+
+def consumer_counts(stages, outputs=()):
+    """{Source: consumer count} over every stage input list, with every
+    requested output charged one extra consumer (the final read) so a
+    requested Source never looks private to its one graph consumer."""
+    counts = {}
+    for stage in stages:
+        for src in stage.inputs:
+            counts[src] = counts.get(src, 0) + 1
+    for src in outputs:
+        counts[src] = counts.get(src, 0) + 1
+    return counts
+
+
+def producer_index(stages):
+    """{output Source: stage index}."""
+    return {stage.output: i for i, stage in enumerate(stages)}
+
+
+def executed_stage_count(graph):
+    """Stages the runner actually executes (GInput taps are free)."""
+    return sum(1 for s in graph.stages if not isinstance(s, GInput))
+
+
+def stage_kind(stage):
+    if isinstance(stage, GInput):
+        return "input"
+    if isinstance(stage, GMap):
+        return "map"
+    if isinstance(stage, GReduce):
+        return "reduce"
+    if isinstance(stage, GSink):
+        return "sink"
+    return type(stage).__name__
+
+
+def _part_name(p):
+    fn = None
+    for attr in ("mapper", "f", "key_f", "streamer_f", "reducer",
+                 "stream_f", "crosser", "sinker"):
+        fn = getattr(p, attr, None)
+        if fn is not None:
+            break
+    label = type(p).__name__
+    name = getattr(fn, "__name__", None)
+    if name and name != "<lambda>":
+        return "{}({})".format(label, name)
+    return label
+
+
+def describe_stage(stage):
+    """Human-readable one-liner for explain() output."""
+    if isinstance(stage, GInput):
+        return "input[{}]".format(type(stage.tap).__name__)
+    if isinstance(stage, GMap):
+        parts = " . ".join(_part_name(p) for p in flatten_mapper(stage.mapper))
+        extra = ""
+        if has_combiner(stage):
+            extra += " +combiner"
+        if stage.options.get("memory"):
+            extra += " +pinned"
+        if stage.options.get("barrier"):
+            extra += " +barrier"
+        return "map[{}]{}".format(parts, extra)
+    if isinstance(stage, GReduce):
+        return "reduce[{}]".format(_part_name(stage.reducer))
+    if isinstance(stage, GSink):
+        return "sink[{} -> {}]".format(_part_name(stage.sinker), stage.path)
+    return repr(stage)
+
+
+def stage_shape(stage):
+    """Cheap structural key for matching a stage against a prior run's
+    stats history (cost.py): kind plus the operator chain's class names.
+    Deliberately ignores captured values — two runs of the same pipeline
+    code produce identical shapes."""
+    if isinstance(stage, GInput):
+        return "input:" + type(stage.tap).__name__
+    if isinstance(stage, GMap):
+        names = ".".join(type(p).__name__
+                         for p in flatten_mapper(stage.mapper))
+        if has_combiner(stage):
+            names += "+c"
+        return "map:" + names
+    if isinstance(stage, GReduce):
+        return "reduce:" + type(stage.reducer).__name__
+    if isinstance(stage, GSink):
+        return "sink:" + type(stage.sinker).__name__
+    return "other:" + type(stage).__name__
+
+
+def stage_shapes(graph):
+    """Per-executed-stage shape records, keyed the way the runner numbers
+    stages (sid = index in the full stage list, GInputs included)."""
+    return [{"sid": i, "shape": stage_shape(s)}
+            for i, s in enumerate(graph.stages) if not isinstance(s, GInput)]
+
+
+def graph_signature(graph):
+    """Structural signature for idempotence checks: stage kinds, operator
+    identities, and input wiring (as producer positions)."""
+    pos = {s.output: i for i, s in enumerate(graph.stages)}
+    sig = []
+    for stage in graph.stages:
+        ops = ()
+        if isinstance(stage, GMap):
+            ops = tuple(id(p) for p in flatten_mapper(stage.mapper))
+            ops += (id(stage.combiner), id(stage.shuffler))
+        elif isinstance(stage, GReduce):
+            ops = (id(stage.reducer),)
+        elif isinstance(stage, GSink):
+            ops = tuple(id(p) for p in flatten_mapper(stage.sinker))
+            ops += (stage.path,)
+        sig.append((stage_kind(stage),
+                    tuple(pos.get(s, -1) for s in stage.inputs),
+                    ops,
+                    tuple(sorted((k, repr(v)) for k, v in
+                                 (stage.options or {}).items()))))
+    return tuple(sig)
+
+
+def clone_with_options(stage, options):
+    """Fresh node with replaced options — shared StageNodes are never
+    mutated (graphs are copy-on-write; a node may live in other handles'
+    graphs)."""
+    if isinstance(stage, GMap):
+        return GMap(stage.inputs, stage.output, stage.mapper,
+                    stage.combiner, stage.shuffler, options)
+    if isinstance(stage, GReduce):
+        return GReduce(stage.inputs, stage.output, stage.reducer, options)
+    if isinstance(stage, GSink):
+        return GSink(stage.inputs, stage.output, stage.sinker, stage.path,
+                     options)
+    raise TypeError("cannot clone {!r}".format(stage))
+
+
+def rebuilt(stages):
+    return Graph(stages)

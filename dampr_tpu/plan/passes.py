@@ -1,0 +1,183 @@
+"""The pass pipeline: Graph -> Graph rewrites.
+
+Rules (each with a kill switch in :mod:`dampr_tpu.settings`):
+
+- **dead-stage elimination** (``settings.plan_dead``): stages unreachable
+  from any requested output or durable sink are dropped — the stage walk
+  otherwise executes every stage in construction order, reachable or not.
+- **map fusion** (``settings.plan_fuse``): ``A -> B`` GMap pairs where
+  A's output has exactly one consumer (B), A carries no combiner, and
+  neither side is a barrier collapse into one stage.  Two sub-rules:
+  pure per-record chains on both sides compose into one fused mapper;
+  an identity tail (checkpoint head) dissolves into ANY producer mapper
+  (block mappers keep their vectorized ``map_blocks`` path untouched).
+  The tail's combiner/shuffler/output always survive on the fused stage.
+- **combiner hoisting** (``settings.plan_hoist``): the identity-dissolve
+  sub-rule applied to a combiner-carrying tail — the map-side fold the
+  DSL plants as a separate identity stage runs inside the producer's map
+  jobs, deleting a full materialize boundary.
+- **sink fusion** (``settings.plan_fuse_sinks``): a pure record chain
+  whose single consumer is a GSink composes into the sinker, so the sink
+  streams transformed records straight off its input.
+
+Barriers (boundaries fusion never erases): explicit ``checkpoint()``
+stages (``options["barrier"]``) and ``cached()`` pins (``memory``) never
+dissolve into their consumer — their materialization point survives
+(they may still absorb a private producer, which removes the producer's
+boundary, not theirs); stages whose chain contains ``Sample`` or
+``Inspect`` fuse in neither direction (their ops observe record
+grouping); and any Source with more than one consumer stays put —
+including the shared prefixes ``Graph.union`` dedupes and every
+requested output.  See ``docs/plan.md``.
+
+All rewrites build fresh StageNodes; nodes of the input graph are never
+mutated (they may be shared with other live handles).
+"""
+
+import logging
+
+from .. import settings
+from ..graph import GMap, GSink
+from . import ir
+
+log = logging.getLogger("dampr_tpu.plan")
+
+
+def _dead_stage_elimination(stages, outputs, report):
+    """Keep only stages reachable (via inputs) from a requested output or
+    a durable sink."""
+    needed = set(outputs)
+    keep = [False] * len(stages)
+    for i in range(len(stages) - 1, -1, -1):
+        stage = stages[i]
+        if isinstance(stage, GSink) or stage.output in needed:
+            keep[i] = True
+            needed.update(stage.inputs)
+    dropped = [i for i, k in enumerate(keep) if not k]
+    if not dropped:
+        return stages
+    report["rules"]["dead_stages"] += len(dropped)
+    report["dead"].extend(
+        "s{}:{}".format(i, ir.describe_stage(stages[i])) for i in dropped)
+    return [s for i, s in enumerate(stages) if keep[i]]
+
+
+def _fusable_pair(a, b, counts, protected):
+    """May GMap ``b`` absorb its producer GMap ``a``?  Returns the rule
+    name ('fuse_maps' / 'hoist_combiners') or None.
+
+    The head must not be a barrier (its output is the materialization the
+    user asked for); the tail only blocks on granularity-sensitive ops —
+    a checkpoint()/cached() tail absorbing its producer keeps its own
+    boundary (and pin) intact while deleting the producer's."""
+    if ir.stage_is_barrier(a) or ir.has_barrier_ops(b):
+        return None
+    if a.output in protected or counts.get(a.output, 0) != 1:
+        return None
+    if ir.has_combiner(a):
+        # A combiner head is a shuffle boundary: its folded output IS the
+        # stage contract its reduce consumer folds again.
+        return None
+    if ir.is_identity_mapper(b.mapper):
+        # Identity tail dissolves into any producer (checkpoint elision /
+        # combiner hoist); the producer's mapper — and with it the
+        # vectorized map_blocks / window_sink paths — is untouched.
+        return "hoist_combiners" if ir.has_combiner(b) else "fuse_maps"
+    if ir.is_record_chain(a.mapper) and ir.is_record_chain(b.mapper):
+        return "fuse_maps"
+    return None
+
+
+def _fuse_maps(stages, protected, report):
+    """Fixed-point fusion sweep over GMap->GMap (and GMap->GSink) pairs."""
+    do_maps = settings.plan_fuse
+    do_hoist = settings.plan_hoist
+    do_sinks = settings.plan_fuse_sinks
+    if not (do_maps or do_hoist or do_sinks):
+        return stages
+    stages = list(stages)
+    changed = True
+    while changed:
+        changed = False
+        counts = ir.consumer_counts(stages, protected)
+        producer = ir.producer_index(stages)
+        for bi, b in enumerate(stages):
+            if len(getattr(b, "inputs", ())) < 1:
+                continue
+            ai = producer.get(b.inputs[0])
+            if ai is None:
+                continue
+            a = stages[ai]
+            if not isinstance(a, GMap):
+                continue
+            if isinstance(b, GMap) and len(b.inputs) == 1:
+                rule = _fusable_pair(a, b, counts, protected)
+                if rule is None:
+                    continue
+                if rule == "fuse_maps" and not do_maps:
+                    continue
+                if rule == "hoist_combiners" and not do_hoist:
+                    continue
+                if ir.is_identity_mapper(b.mapper):
+                    mapper = a.mapper
+                else:
+                    mapper = ir.compose_mappers(a.mapper, b.mapper)
+                fused = GMap(a.inputs, b.output, mapper,
+                             b.combiner, b.shuffler,
+                             ir.merge_options(a.options, b.options))
+            elif (isinstance(b, GSink) and do_sinks
+                    and len(b.inputs) == 1
+                    and not ir.stage_is_barrier(a)
+                    and a.output not in protected
+                    and counts.get(a.output, 0) == 1
+                    and not ir.has_combiner(a)
+                    and ir.is_record_chain(a.mapper)
+                    and ir.is_record_chain(b.sinker)):
+                rule = "fuse_sinks"
+                fused = GSink(a.inputs, b.output,
+                              ir.compose_mappers(a.mapper, b.sinker),
+                              b.path, ir.merge_options(a.options, b.options))
+            else:
+                continue
+            report["rules"][rule] += 1
+            report["fused"].append({
+                "rule": rule,
+                "into": ir.describe_stage(fused),
+                "members": [ir.describe_stage(a), ir.describe_stage(b)],
+            })
+            # The fused node takes the producer's slot (its inputs'
+            # producers all precede it); the tail's slot disappears.
+            stages[ai] = fused
+            del stages[bi]
+            changed = True
+            break
+    return stages
+
+
+def optimize(graph, outputs):
+    """Rewrite ``graph`` for the requested ``outputs``.
+
+    Returns ``(graph, report)``.  When no rule fires the ORIGINAL graph
+    object comes back (so ``optimize(optimize(g)) is optimize(g)`` — the
+    idempotence the property tests pin).  ``outputs`` are the Sources the
+    caller will read; they are never fused away or eliminated.
+    """
+    from . import empty_report
+
+    report = empty_report(graph, enabled=True)
+    protected = set(outputs)
+    stages = list(graph.stages)
+    if settings.plan_dead:
+        stages = _dead_stage_elimination(stages, protected, report)
+    stages = _fuse_maps(stages, protected, report)
+    fired = sum(report["rules"].values())
+    if not fired:
+        report["stages_after"] = report["stages_before"]
+        return graph, report
+    out = ir.rebuilt(stages)
+    report["stages_after"] = ir.executed_stage_count(out)
+    log.info("plan: %d -> %d stages (%s)", report["stages_before"],
+             report["stages_after"],
+             ", ".join("{}={}".format(k, v)
+                       for k, v in sorted(report["rules"].items()) if v))
+    return out, report

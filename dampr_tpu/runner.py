@@ -723,6 +723,13 @@ class MTRunner(object):
         self.n_maps = n_maps or settings.max_processes
         self.n_reducers = n_reducers or settings.max_processes
         self.n_partitions = n_partitions or settings.partitions
+        # Logical plan optimizer state (dampr_tpu.plan): the report lands
+        # here when the plan is applied (by the DSL entry points or by
+        # run() below — first caller wins) and feeds the run summary's
+        # "plan" section.  An explicitly-passed partition count is pinned:
+        # the cost layer's adaptive sizing only retunes the default.
+        self.plan_report = None
+        self._explicit_partitions = n_partitions is not None
         self.store = storage.RunStore(name, budget=memory_budget)
         self.stats = []
         self.mesh_folds = 0  # reduces executed via the mesh collective path
@@ -1208,9 +1215,13 @@ class MTRunner(object):
 
             return push, end
 
+        # Per-stage block sizing: the plan's cost layer may have set a
+        # batch_size option from observed bytes/record history.
+        stage_batch = stage.options.get("batch_size") or settings.batch_size
+
         def job(chunk):
             mapper = _clone_op(stage.mapper)
-            builder = BlockBuilder(settings.batch_size)
+            builder = BlockBuilder(stage_batch)
             # Vectorized block protocol: mappers exposing map_blocks consume
             # the chunk's raw bytes and emit whole Blocks, skipping the
             # per-record Python path entirely (the SURVEY §7 dual-path).
@@ -1245,7 +1256,7 @@ class MTRunner(object):
                 for blk in chunk.iter_blocks():
                     push(blk)
             elif chain is not None:
-                B = settings.batch_size
+                B = stage_batch
                 reader = getattr(chunk, "read_lists", None)
                 if reader is not None:
                     batches = reader(B)
@@ -2164,8 +2175,14 @@ class MTRunner(object):
             _flightrec.stop(self.flightrec)
 
     def run(self, outputs, cleanup=True):
+        from . import plan as _plan
         from .ops import devtime
 
+        # Optimize the stage list for the requested outputs (no-op when
+        # the DSL already applied a plan, or settings.optimize is off —
+        # the report records either way).  Before obs setup: stage counts
+        # and resume fingerprints must see the final graph.
+        _plan.apply_to_runner(self, outputs)
         wall_start = time.time()
         epoch = devtime.epoch()
         rec = self._start_obs()
@@ -2279,6 +2296,10 @@ class MTRunner(object):
             },
             "streamed_assoc_folds": self.streamed_assoc_folds,
             "retries": self.retries_total,
+            # The logical plan that executed: stages before/after the
+            # optimizer, rules fired, adaptive sizing decisions, and the
+            # stage shapes the NEXT run's cost layer matches against.
+            "plan": self.plan_report or {"enabled": False},
             "trace_file": None,
             "stats_file": None,
         }
@@ -2377,6 +2398,17 @@ class MTRunner(object):
         st.retries = self.retries_total - snap[4]
 
     def _run_stages(self, outputs, cleanup):
+        rep = self.plan_report
+        if rep is not None:
+            # The plan decision record on the stage timeline: how many
+            # construction-order stages collapsed into the schedule below.
+            _trace.instant(
+                "plan", "optimize", lane="stages",
+                enabled=bool(rep.get("enabled")),
+                stages_before=rep.get("stages_before"),
+                stages_after=rep.get("stages_after"),
+                rules={k: v for k, v in (rep.get("rules") or {}).items()
+                       if v})
         env = {}
         to_delete = []
         fused = {}  # sid -> (pset, nrec, njobs) computed by an earlier pass

@@ -256,6 +256,58 @@ merge_fanin = int(os.environ.get("DAMPR_TPU_MERGE_FANIN", "512"))
 def sort_runs_enabled():
     return str(sort_runs).lower() not in ("off", "0", "false")
 
+# ---------------------------------------------------------------------------
+# Logical plan optimizer (dampr_tpu.plan — see docs/plan.md)
+# ---------------------------------------------------------------------------
+
+#: Master switch for the logical plan optimizer: every run's stage list is
+#: rewritten (map fusion, combiner hoisting, sink fusion, dead-stage
+#: elimination, stats-driven sizing) before execution.  Off, the graph
+#: executes exactly as constructed — one stage per chained DSL call — the
+#: reference's literal schedule.  Results are identical either way (the
+#: optimizer-equivalence property tests pin it); this only changes how
+#: many materialize boundaries the run pays.
+def _env_flag(name):
+    """Shared on/off env parsing: 0/false/no/off (any case) disable."""
+    return os.environ.get(name, "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+optimize = _env_flag("DAMPR_TPU_OPTIMIZE")
+
+#: Per-rule kill switches (all default on; only consulted when
+#: ``optimize`` is on).  plan_fuse: compose chains of pure per-record map
+#: stages; plan_hoist: dissolve identity+combiner stages into their
+#: producer (the map-side fold runs inside the producer's jobs);
+#: plan_fuse_sinks: compose record chains into sink stages; plan_dead:
+#: drop stages unreachable from any requested output or sink;
+#: plan_adapt: size partitions/batches from the prior run's stats.json.
+plan_fuse = _env_flag("DAMPR_TPU_PLAN_FUSE")
+plan_hoist = _env_flag("DAMPR_TPU_PLAN_HOIST")
+plan_fuse_sinks = _env_flag("DAMPR_TPU_PLAN_FUSE_SINKS")
+plan_dead = _env_flag("DAMPR_TPU_PLAN_DEAD")
+plan_adapt = _env_flag("DAMPR_TPU_PLAN_ADAPT")
+
+#: Adaptive sizing targets (dampr_tpu.plan.cost): bytes of reduce input
+#: one partition should carry (drives the adapted partition count), and
+#: the byte size a map-stage output block should target when history
+#: shows fat records (drives per-stage ``batch_size`` options).
+plan_partition_bytes = int(os.environ.get(
+    "DAMPR_TPU_PLAN_PARTITION_BYTES", str(32 * 1024 ** 2)))
+plan_block_bytes = int(os.environ.get(
+    "DAMPR_TPU_PLAN_BLOCK_BYTES", str(8 * 1024 ** 2)))
+
+#: Deterministic seeding for ``sample(prob)``: None (default) keeps the
+#: historical behavior — each worker thread draws from a time-seeded RNG,
+#: so sampled pipelines are NOT reproducible run to run.  An int seeds
+#: every per-thread RNG deterministically (re-derived at each run start),
+#: making sampled pipelines reproducible whenever job->thread assignment
+#: is deterministic — serial runs (``max_processes=1`` or single-job
+#: stages) exactly, parallel runs per-thread-stream.  This is what lets
+#: the optimizer-equivalence tests pin sampled pipelines.
+seed = (int(os.environ["DAMPR_TPU_SEED"])
+        if os.environ.get("DAMPR_TPU_SEED") else None)
+
 #: Spill compression policy: "auto" (default) compresses object-lane
 #: blocks and writes fully-numeric blocks raw (high-entropy lanes don't
 #: compress and the codec pass is core-bound both ways); "always"/"never"

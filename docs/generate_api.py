@@ -22,6 +22,11 @@ MODULES = [
     "dampr_tpu.dataset",
     "dampr_tpu.inputs",
     "dampr_tpu.graph",
+    "dampr_tpu.plan",
+    "dampr_tpu.plan.ir",
+    "dampr_tpu.plan.passes",
+    "dampr_tpu.plan.cost",
+    "dampr_tpu.plan.explain",
     "dampr_tpu.runner",
     "dampr_tpu.storage",
     "dampr_tpu.io",

@@ -1,0 +1,401 @@
+"""The logical plan optimizer (dampr_tpu.plan): fusion rules, barriers,
+dead-stage elimination, combiner hoisting, adaptive sizing, explain(),
+and the plan observability surface."""
+
+import operator
+import os
+
+import pytest
+
+from dampr_tpu import Dampr, Mapper, settings
+from dampr_tpu.dampr import Dampr as _D, PMap
+from dampr_tpu.graph import GInput, GMap, GReduce, GSink
+from dampr_tpu.plan import graph_signature, ir, passes
+
+
+def _executed(graph):
+    return [s for s in graph.stages if not isinstance(s, GInput)]
+
+
+@pytest.fixture(autouse=True)
+def optimizer_on():
+    old = (settings.optimize, settings.plan_fuse, settings.plan_hoist,
+           settings.plan_fuse_sinks, settings.plan_dead, settings.plan_adapt)
+    settings.optimize = True
+    settings.plan_fuse = settings.plan_hoist = True
+    settings.plan_fuse_sinks = settings.plan_dead = True
+    settings.plan_adapt = True
+    yield
+    (settings.optimize, settings.plan_fuse, settings.plan_hoist,
+     settings.plan_fuse_sinks, settings.plan_dead,
+     settings.plan_adapt) = old
+
+
+class TestFusion:
+    def test_four_op_chain_executes_as_two_stages(self):
+        """The acceptance pipeline: map.map_values.filter.fold_by is ~6
+        constructed stages and must execute as <= 2."""
+        pipe = (Dampr.memory(list(range(500)))
+                .map(lambda x: (x % 7, x))
+                .map_values(lambda v: v * 2)
+                .filter(lambda kv: kv[1] % 4 == 0)
+                .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
+        em = pipe.run()
+        plan = em.stats()["plan"]
+        assert plan["enabled"] is True
+        assert plan["stages_before"] >= 5
+        assert plan["stages_after"] <= 2
+        assert len(em.stats) <= 2  # executed StageStats
+        assert {s["kind"] for s in em.stats} == {"map", "reduce"}
+        # explain() shows the same collapse without executing
+        text = pipe.explain()
+        assert "optimized plan (2 executed)" in text
+        assert "hoist_combiners" in text
+        # and the result is right
+        want = {}
+        for x in range(500):
+            v = x * 2
+            if v % 4 == 0:
+                want[x % 7] = want.get(x % 7, 0) + v
+        assert dict(em.read()) == want
+        em.delete()
+
+    def test_optimized_matches_unoptimized(self):
+        pipe = (Dampr.memory(list(range(300)))
+                .map(lambda x: x + 1)
+                .filter(lambda x: x % 3 != 0)
+                .flat_map(lambda x: (x, -x))
+                .sort_by(lambda x: x))
+        opt = pipe.read()
+        settings.optimize = False
+        unopt = pipe.read()
+        settings.optimize = True
+        assert opt == unopt
+
+    def test_identity_tail_dissolves_into_block_mapper(self):
+        """An internal identity stage over a non-record-chain producer is
+        eliminated without touching the producer's mapper (its vectorized
+        paths survive)."""
+        from dampr_tpu.base import Map, _identity
+        from dampr_tpu.ops.text import CountRecords
+
+        pipe = Dampr.memory(list(range(100))).custom_mapper(CountRecords())
+        # join-style internal materialization: force an identity stage
+        # over the block mapper through the graph API
+        src, pmer = pipe.pmer._add_mapper([pipe.source], Map(_identity))
+        g, report = passes.optimize(pmer.graph, [src])
+        assert report["rules"]["fuse_maps"] == 1
+        ex = _executed(g)
+        assert len(ex) == 1 and isinstance(ex[0].mapper, CountRecords)
+
+    def test_combiner_hoists_into_custom_mapper(self):
+        class PairEmit(Mapper):
+            def map(self, *datasets):
+                for _k, v in datasets[0].read():
+                    yield v % 5, 1
+
+        pipe = (Dampr.memory(list(range(200)))
+                .custom_mapper(PairEmit())
+                .fold_values(operator.add))
+        g, report = passes.optimize(pipe.pmer.graph, [pipe.source])
+        assert report["rules"]["hoist_combiners"] == 1
+        ex = _executed(g)
+        assert len(ex) == 2
+        assert isinstance(ex[0], GMap) and isinstance(ex[0].mapper, PairEmit)
+        assert ex[0].combiner is not None  # the hoisted map-side fold
+        assert isinstance(ex[1], GReduce)
+        got = dict(pipe.read())
+        assert got == {i: 40 for i in range(5)}
+
+    def test_sink_fusion_composes_record_chain_into_sinker(self, tmp_path):
+        out = str(tmp_path / "parts")
+        pipe = (Dampr.memory(list(range(20)))
+                .map(lambda x: x * 10)
+                .filter(lambda x: x < 100)
+                .sink(out))
+        g, report = passes.optimize(pipe.pmer.graph, [pipe.source])
+        assert report["rules"]["fuse_sinks"] >= 1
+        assert all(not isinstance(s, GMap) for s in _executed(g))
+        em = pipe.run()
+        lines = sorted(int(line) for p in sorted(os.listdir(out))
+                       for line in open(os.path.join(out, p)))
+        assert lines == [x * 10 for x in range(10)]
+        assert em.stats()["plan"]["rules"]["fuse_sinks"] >= 1
+
+    def test_idempotent(self):
+        pipe = (Dampr.memory(list(range(50)))
+                .map(lambda x: x + 1)
+                .map(lambda x: x * 2)
+                .fold_by(lambda x: x % 3, operator.add))
+        g1, r1 = passes.optimize(pipe.pmer.graph, [pipe.source])
+        assert sum(r1["rules"].values()) > 0
+        g2, r2 = passes.optimize(g1, [pipe.source])
+        assert g2 is g1, "second optimize must be a no-op"
+        assert sum(r2["rules"].values()) == 0
+        assert graph_signature(g2) == graph_signature(g1)
+
+
+class TestBarriers:
+    """Fusion must not cross checkpoint(), inspect(), sample(), or
+    multi-consumer Sources (branch + union shared-prefix reuse)."""
+
+    def _mapper_stages(self, g):
+        return [s for s in g.stages if isinstance(s, GMap)]
+
+    def test_checkpoint_is_a_barrier(self):
+        pipe = (Dampr.memory(list(range(40)))
+                .map(lambda x: x + 1)
+                .checkpoint()
+                .map(lambda x: x * 2))
+        g, report = passes.optimize(pipe.pmer.graph, [pipe.source])
+        maps = self._mapper_stages(g)
+        # the checkpoint's materialization boundary survives: the stage
+        # after it is NOT fused with the stage carrying the barrier (the
+        # checkpoint may absorb its private producer — that removes the
+        # producer's boundary, never its own)
+        barriers = [s for s in maps if (s.options or {}).get("barrier")]
+        assert len(barriers) == 1
+        assert len(maps) == 2  # [f + checkpoint], [g]
+        tail = [s for s in maps if s is not barriers[0]]
+        assert tail[0].inputs == [barriers[0].output]
+        assert pipe.read() == sorted((x + 1) * 2 for x in range(40))
+
+    def test_cached_pin_survives_and_absorbs_producer(self):
+        pipe = (Dampr.memory(list(range(30)))
+                .map(lambda x: x + 1)
+                .cached()
+                .map(lambda x: x * 2))
+        g, report = passes.optimize(pipe.pmer.graph, [pipe.source])
+        maps = self._mapper_stages(g)
+        pinned = [s for s in maps if (s.options or {}).get("memory")]
+        assert len(pinned) == 1 and len(maps) == 2
+        # the pin's consumer is not fused into it
+        assert maps[1].inputs == [pinned[0].output]
+        assert pipe.read() == sorted((x + 1) * 2 for x in range(30))
+
+    def test_inspect_is_a_barrier(self, capsys):
+        pipe = (Dampr.memory([1, 2])
+                .map(lambda x: x + 1)
+                .inspect("dbg")
+                .map(lambda x: x * 2))
+        g, report = passes.optimize(pipe.pmer.graph, [pipe.source])
+        assert report["rules"]["fuse_maps"] == 0
+        assert len(self._mapper_stages(g)) == 3
+        assert sorted(pipe.read()) == [4, 6]
+        assert "dbg" in capsys.readouterr().out
+
+    def test_sample_is_a_barrier(self):
+        pipe = (Dampr.memory(list(range(100)))
+                .map(lambda x: x + 1)
+                .sample(0.5)
+                .map(lambda x: x * 2))
+        g, report = passes.optimize(pipe.pmer.graph, [pipe.source])
+        assert report["rules"]["fuse_maps"] == 0
+        assert len(self._mapper_stages(g)) == 3
+
+    def test_multi_consumer_source_not_fused(self):
+        """A branched prefix (union shared-prefix dedup) computes once and
+        is never duplicated into its consumers."""
+        base = Dampr.memory(list(range(60))).map(lambda x: x + 1)
+        left = base.map(lambda x: x * 2)
+        right = base.map(lambda x: -x)
+        joined = left.join(right)  # joins on the shared position keys
+        out = joined.reduce(lambda l, r: (sorted(l), sorted(r)))
+        g, report = passes.optimize(out.pmer.graph, [out.source])
+        cons = ir.consumer_counts(g.stages, [out.source])
+        multi = [src for src, n in cons.items() if n > 1]
+        assert multi, "expected a shared multi-consumer Source to survive"
+        # the shared prefix appears exactly once (union dedup preserved,
+        # not duplicated into both branches by fusion)
+        producers = [s for s in g.stages if s.output in multi]
+        assert len(producers) == len(multi)
+        got = dict(out.read())
+        want = {k: ([(k + 1) * 2], [-(k + 1)]) for k in range(60)}
+        assert got == want
+
+    def test_requested_output_never_fused_away(self):
+        x = Dampr.memory(list(range(30))).map(lambda v: v + 1)
+        y = x.map(lambda v: v * 2)
+        # both requested: x's stage must survive even though y is its
+        # only graph consumer
+        outs = _D.run(x, y)
+        assert sorted(outs[0].stream()) == list(range(1, 31))
+        assert sorted(outs[1].stream()) == [2 * v for v in range(1, 31)]
+
+
+class TestDeadStages:
+    def test_unreachable_branch_eliminated(self):
+        a = Dampr.memory(list(range(25)))
+        b = a.map(lambda x: x + 1)
+        c = a.map(lambda x: x * 1000)  # never read
+        joined = b.join(c)  # union graph holds both branches
+        only_b = PMap(b.source, _D(joined.pmer.graph))
+        g, report = passes.optimize(only_b.pmer.graph, [only_b.source])
+        assert report["rules"]["dead_stages"] >= 1
+        em = only_b.run()
+        assert sorted(em.read()) == list(range(1, 26))
+        assert em.stats()["plan"]["rules"]["dead_stages"] >= 1
+        em.delete()
+
+    def test_sinks_always_kept(self, tmp_path):
+        out = str(tmp_path / "kept")
+        sunk = Dampr.memory([1, 2, 3]).map(str).sink(out)
+        # request something unrelated in the same graph: the sink still runs
+        g, report = passes.optimize(sunk.pmer.graph, [])
+        assert any(isinstance(s, GSink) for s in g.stages)
+
+
+class TestKillSwitches:
+    def _pipe(self):
+        return (Dampr.memory(list(range(40)))
+                .map(lambda x: x + 1)
+                .map(lambda x: x * 2)
+                .fold_by(lambda x: x % 5, operator.add))
+
+    def test_optimize_off_runs_constructed_graph(self):
+        settings.optimize = False
+        em = self._pipe().run()
+        plan = em.stats()["plan"]
+        assert plan["enabled"] is False
+        assert plan["stages_before"] == plan["stages_after"]
+        assert len(em.stats) == plan["stages_before"]
+        em.delete()
+
+    def test_plan_fuse_off(self):
+        settings.plan_fuse = False
+        settings.plan_hoist = False
+        g, report = passes.optimize(self._pipe().pmer.graph,
+                                    [self._pipe().source])
+        assert report["rules"]["fuse_maps"] == 0
+        assert report["rules"]["hoist_combiners"] == 0
+
+    def test_plan_dead_off(self):
+        settings.plan_dead = False
+        a = Dampr.memory([1])
+        b = a.map(lambda x: x)
+        c = a.map(lambda x: -x)
+        j = b.join(c)
+        only_b = PMap(b.source, _D(j.pmer.graph))
+        g, report = passes.optimize(only_b.pmer.graph, [only_b.source])
+        assert report["rules"]["dead_stages"] == 0
+
+
+class TestAdaptive:
+    def test_history_drives_sizing_and_results_stable(self, tmp_path):
+        old_trace, old_dir = settings.trace, settings.trace_dir
+        settings.trace = True
+        settings.trace_dir = str(tmp_path)
+        try:
+            def pipe():
+                return (Dampr.memory(list(range(2000)))
+                        .map(lambda x: (x % 5, x))
+                        .fold_by(lambda kv: kv[0], operator.add,
+                                 lambda kv: kv[1]))
+
+            em1 = pipe().run(name="plan-adapt-test")
+            r1 = sorted(em1.read())
+            em2 = pipe().run(name="plan-adapt-test")
+            r2 = sorted(em2.read())
+            ad = em2.stats()["plan"]["adaptive"]
+            assert ad["applied"] is True
+            assert any(c["what"] == "n_partitions" for c in ad["changes"])
+            assert r1 == r2
+            em2.delete()
+        finally:
+            settings.trace, settings.trace_dir = old_trace, old_dir
+
+    def test_no_history_static_defaults(self):
+        em = (Dampr.memory([1, 2, 3]).map(lambda x: x)
+              .run(name="plan-no-history-{}".format(os.getpid())))
+        ad = em.stats()["plan"]["adaptive"]
+        assert ad["applied"] is False
+        assert ad["reason"] in ("no-history", "disabled")
+        em.delete()
+
+    def test_explicit_partitions_pinned(self, tmp_path):
+        from dampr_tpu.runner import MTRunner
+
+        old_trace, old_dir = settings.trace, settings.trace_dir
+        settings.trace = True
+        settings.trace_dir = str(tmp_path)
+        try:
+            pipe = (Dampr.memory(list(range(500)))
+                    .map(lambda x: (x % 3, x))
+                    .fold_by(lambda kv: kv[0], operator.add,
+                             lambda kv: kv[1]))
+            r1 = MTRunner("plan-pin-test", pipe.pmer.graph, n_partitions=7)
+            r1.run([pipe.source])
+            r2 = MTRunner("plan-pin-test", pipe.pmer.graph, n_partitions=7)
+            r2.run([pipe.source])
+            assert r2.n_partitions == 7, "explicit partition count retuned"
+        finally:
+            settings.trace, settings.trace_dir = old_trace, old_dir
+
+
+class TestSeededSample:
+    def test_seeded_sample_reproducible_serial(self):
+        old_seed, old_procs = settings.seed, settings.max_processes
+        settings.seed, settings.max_processes = 1234, 1
+        try:
+            def pipe():
+                return (Dampr.memory(list(range(400)))
+                        .sample(0.5)
+                        .map(lambda x: x * 2))
+
+            a = pipe().read()
+            b = pipe().read()
+            assert a == b, "seeded serial sample must reproduce"
+            # and optimized-vs-unoptimized equivalence holds for sampled
+            # pipelines (sample at the head: its input chunking is the
+            # tap's either way)
+            settings.optimize = False
+            c = pipe().read()
+            settings.optimize = True
+            assert a == c
+            assert 0 < len(a) < 800
+        finally:
+            settings.seed, settings.max_processes = old_seed, old_procs
+
+    def test_unseeded_sample_varies(self):
+        assert settings.seed is None
+        pipe = Dampr.memory(list(range(2000))).sample(0.5)
+        a, b = pipe.read(), pipe.read()
+        # astronomically unlikely to collide across 2000 coin flips
+        assert a != b
+
+
+class TestObservabilitySurface:
+    def test_plan_span_in_trace(self, tmp_path):
+        old_trace, old_dir = settings.trace, settings.trace_dir
+        settings.trace = True
+        settings.trace_dir = str(tmp_path)
+        try:
+            em = (Dampr.memory(list(range(100))).map(lambda x: x + 1)
+                  .run(name="plan-span-test"))
+            import json
+
+            with open(em.stats()["trace_file"]) as f:
+                doc = json.load(f)
+            cats = {ev.get("cat") for ev in doc["traceEvents"]
+                    if ev.get("ph") in ("X", "i")}
+            assert "plan" in cats
+            em.delete()
+        finally:
+            settings.trace, settings.trace_dir = old_trace, old_dir
+
+    def test_explain_does_not_execute_or_mutate(self):
+        pipe = (Dampr.memory([1, 2, 3]).map(lambda x: x + 1)
+                .map(lambda x: x * 2))
+        before = graph_signature(pipe.pmer.graph)
+        text = pipe.explain()
+        assert "optimized plan" in text
+        assert graph_signature(pipe.pmer.graph) == before
+
+    def test_stats_plan_section_always_present(self):
+        em = Dampr.memory([1]).map(lambda x: x).run()
+        assert "plan" in em.stats()
+        em.delete()
+        settings.optimize = False
+        em2 = Dampr.memory([1]).map(lambda x: x).run()
+        assert em2.stats()["plan"]["enabled"] is False
+        em2.delete()

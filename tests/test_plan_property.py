@@ -1,0 +1,116 @@
+"""Optimizer equivalence property tests (plan-optimizer satellite):
+randomized pipelines mixing map / filter / flat_map / map_values /
+fold_by / sort_by / join run byte-identical with ``settings.optimize``
+on and off, and the pass pipeline is idempotent on every generated
+graph."""
+
+import operator
+import random
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.plan import graph_signature, passes
+
+
+@pytest.fixture(autouse=True)
+def optimizer_on():
+    old = settings.optimize
+    settings.optimize = True
+    yield
+    settings.optimize = old
+
+
+def _unary_op(rng, pipe):
+    """One random per-record op (int values in, int values out)."""
+    roll = rng.randrange(5)
+    if roll == 0:
+        k = rng.randrange(1, 50)
+        return pipe.map(lambda x, k=k: x + k)
+    if roll == 1:
+        m = rng.randrange(2, 7)
+        return pipe.filter(lambda x, m=m: x % m != 0)
+    if roll == 2:
+        return pipe.flat_map(lambda x: (x, x + 1000000))
+    if roll == 3:
+        return pipe.sort_by(lambda x: -x)
+    return pipe.checkpoint()  # explicit barriers mix into the soup too
+
+
+def _build(rng, data):
+    """A random pipeline over ``data``; returns a runnable handle."""
+    pipe = Dampr.memory(data, partitions=rng.choice([4, 13, 50]))
+    for _ in range(rng.randrange(1, 5)):
+        pipe = _unary_op(rng, pipe)
+    shape = rng.randrange(4)
+    if shape == 0:
+        # associative fold: (key, sum) pairs, then map_values rides on top
+        m = rng.randrange(2, 9)
+        pipe = (pipe.fold_by(lambda x, m=m: x % m, operator.add)
+                .map_values(lambda v: v * 3))
+    elif shape == 1:
+        # general grouping through a non-associative reduce
+        m = rng.randrange(2, 6)
+        pipe = (pipe.group_by(lambda x, m=m: x % m)
+                .reduce(lambda k, it: sorted(it)[:5]))
+    elif shape == 2:
+        # branch + join: shared prefix (union dedup), co-partitioned join
+        left = pipe.map(lambda x: x * 2)
+        right = pipe.map(lambda x: x - 1)
+        pipe = (left.join(right)
+                .reduce(lambda l, r: (sorted(l), sorted(r))))
+    # shape 3: map-only pipeline, read back key-sorted
+    return pipe
+
+
+CASES = list(range(12))
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_optimized_equals_unoptimized(case):
+    rng = random.Random(9000 + case)
+    data = [rng.randrange(0, 5000) for _ in range(rng.randrange(50, 400))]
+    pipe = _build(rng, data)
+    settings.optimize = True
+    opt = pipe.run()
+    got_opt = opt.read()
+    opt.delete()
+    settings.optimize = False
+    unopt = pipe.run()
+    got_unopt = unopt.read()
+    unopt.delete()
+    assert got_opt == got_unopt, (
+        "case {} diverged: optimized {} records vs {}".format(
+            case, len(got_opt), len(got_unopt)))
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_optimize_is_idempotent(case):
+    rng = random.Random(7000 + case)
+    data = [rng.randrange(0, 1000) for _ in range(60)]
+    pipe = _build(rng, data)
+    g1, r1 = passes.optimize(pipe.pmer.graph, [pipe.source])
+    g2, r2 = passes.optimize(g1, [pipe.source])
+    assert g2 is g1, "optimize(optimize(g)) rewrote an optimized graph"
+    assert sum(r2["rules"].values()) == 0
+    assert graph_signature(g2) == graph_signature(g1)
+
+
+def test_multi_output_equivalence():
+    """Dampr.run with shared prefixes: both emitters identical across
+    optimize on/off (requested outputs are fusion-protected)."""
+    def build():
+        base = Dampr.memory(list(range(200))).map(lambda x: x + 1)
+        a = base.filter(lambda x: x % 2 == 0).fold_by(
+            lambda x: x % 5, operator.add)
+        b = base.map(lambda x: x * 3)
+        return a, b
+
+    a, b = build()
+    settings.optimize = True
+    ra, rb = Dampr.run(a, b)
+    opt = (ra.read(), rb.read())
+    settings.optimize = False
+    ra2, rb2 = Dampr.run(a, b)
+    unopt = (ra2.read(), rb2.read())
+    assert opt == unopt

@@ -116,7 +116,14 @@ class TestEndToEnd:
         from dampr_tpu.runner import MTRunner
 
         old_mesh = settings.mesh_fold
+        old_opt = settings.optimize
         settings.mesh_fold = "off"  # isolate the accumulator path
+        # Pin the fused plan: this test asserts WHICH engine path the
+        # reduce takes, and that depends on the map-side combine staying
+        # per-chunk (under DAMPR_TPU_OPTIMIZE=0 the separate combiner
+        # stage collapses to one tiny-input job, shrinking the reduce
+        # input below the streaming threshold — correct, different path).
+        settings.optimize = True
         try:
             # many chunks x modest key cardinality: per-chunk combined
             # outputs stack up past the threshold per partition, while the
@@ -133,6 +140,7 @@ class TestEndToEnd:
             assert runner.streamed_assoc_folds >= 1
         finally:
             settings.mesh_fold = old_mesh
+            settings.optimize = old_opt
 
 
 class TestVectorMerge:
