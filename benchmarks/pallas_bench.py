@@ -90,13 +90,20 @@ def bench_fnv(iters):
     }
 
 
-def bench_segfold(iters, n=1 << 22):
+def bench_segfold(iters, n=1 << 22, interpret=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from dampr_tpu.ops import pallas_segfold as SF
     from dampr_tpu.parallel.shuffle import _local_fold
+
+    if interpret is None:
+        # Mosaic compiles only for TPU; everywhere else the kernel runs
+        # (and is measured) in interpreter mode — a functional number,
+        # not a hardware one, but it finally gets the kernel on a
+        # measured path (CI runs this tiny).
+        interpret = jax.default_backend() != "tpu"
 
     def gen_sorted(seed):
         key = jax.random.PRNGKey(seed)
@@ -111,7 +118,8 @@ def bench_segfold(iters, n=1 << 22):
     h1, h2, v, inv = gen_sorted(0)
     oinv, oh1, oh2, ov = _local_fold(inv, h1, h2, v, "sum", nonneg_sum=True)
     tot, live = SF.segfold_sorted(np.asarray(h1), np.asarray(h2),
-                                  np.asarray(v), np.asarray(inv))
+                                  np.asarray(v), np.asarray(inv),
+                                  interpret=interpret)
     want = {}
     m = np.asarray(oinv) == 0
     for a, b, t in zip(np.asarray(oh1)[m], np.asarray(oh2)[m],
@@ -135,7 +143,7 @@ def bench_segfold(iters, n=1 << 22):
 
     def pallas_chain(h1, h2, v, inv):
         shape = (n_tiles * SF._ROWS, SF._LANES)
-        tot, live = SF._segfold_call(n_tiles, False)(
+        tot, live = SF._segfold_call(n_tiles, interpret)(
             h1.reshape(shape), h2.reshape(shape), v.reshape(shape),
             inv.reshape(shape))
         return tot[0, 0]
@@ -160,8 +168,11 @@ def bench_segfold(iters, n=1 << 22):
     assert checks["xla_scan"] == checks["pallas"], checks
     return {
         "records": n,
-        "xla_scan_Mrec_s": round(n / results["xla_scan"] / 1e6, 1),
-        "pallas_Mrec_s": round(n / results["pallas"] / 1e6, 1),
+        "interpret": bool(interpret),
+        # 3 decimals: interpret-mode runs at tiny --records on slow CI
+        # boxes must not round a real (correct) measurement down to 0.0
+        "xla_scan_Mrec_s": round(n / results["xla_scan"] / 1e6, 3),
+        "pallas_Mrec_s": round(n / results["pallas"] / 1e6, 3),
         "pallas_speedup": round(results["xla_scan"] / results["pallas"], 2),
     }
 
@@ -172,6 +183,9 @@ def main():
     ap.add_argument("--records", type=int, default=1 << 22,
                     help="segfold record count (multiple of the tile size)")
     ap.add_argument("--only", choices=["fnv", "segfold"])
+    ap.add_argument("--interpret", action="store_true",
+                    help="force Pallas interpreter mode (default: auto — "
+                    "interpreted everywhere but TPU)")
     args = ap.parse_args()
 
     import jax
@@ -184,7 +198,8 @@ def main():
         print(json.dumps(r), flush=True)
     if args.only in (None, "segfold"):
         r = dict(base, kernel="segfold",
-                 **bench_segfold(args.iters, args.records))
+                 **bench_segfold(args.iters, args.records,
+                                 interpret=args.interpret or None))
         print(json.dumps(r), flush=True)
 
 

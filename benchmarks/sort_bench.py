@@ -149,6 +149,19 @@ def main():
         "io_wait_write_fraction": io.get("io_wait_write_fraction"),
         "io_wait_seconds": io.get("io_wait_seconds"),
         "spill_writer_threads": io.get("writer_threads"),
+        # Device lowering (dampr_tpu.plan.lower): the external sort has
+        # no keyed-fold shape, so device_stages stays 0 — pinned here so
+        # the gate notices if a lowering change ever claims a sort stage.
+        # (device_fraction/h2d/d2h are run-wide device counters and may be
+        # nonzero on accelerator hosts via the HBM tier / sort kernels.)
+        "device_fraction": (runner.run_summary or {}).get(
+            "device", {}).get("device_fraction"),
+        "device_stages": (runner.run_summary or {}).get(
+            "device", {}).get("device_stages"),
+        "h2d_bytes": (runner.run_summary or {}).get(
+            "device", {}).get("h2d_bytes"),
+        "d2h_bytes": (runner.run_summary or {}).get(
+            "device", {}).get("d2h_bytes"),
         # Live metrics plane (dampr_tpu.obs.metrics): the sampler's
         # self-measured cost when sampling was on (acceptance gauge:
         # <3% at 100 ms cadence), None with the plane off.

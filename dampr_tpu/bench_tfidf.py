@@ -269,6 +269,14 @@ def main():
         "device_fraction": round(split["device"] / secs, 4),
         "transfer_fraction": round(split["transfer"] / secs, 4),
         "codec_fraction": round(split["codec"] / secs, 4),
+        # Device lowering (dampr_tpu.plan.lower, winning warm run): how
+        # many plan stages compiled to jitted device programs and the
+        # feed/drain bytes the host moved for them — the evidence behind
+        # device_fraction (0 stages + fraction ~0 = the host-codec leg).
+        "lower": _settings.lower_enabled(),
+        "device_stages": summary.get("device", {}).get("device_stages"),
+        "h2d_bytes": summary.get("device", {}).get("h2d_bytes"),
+        "d2h_bytes": summary.get("device", {}).get("d2h_bytes"),
         # Codec-attributable NON-overlapped fraction of the wall: codec
         # seconds the fold actually waited on (the full codec bucket when
         # the overlap executor is off).  This is the number the overlap

@@ -283,6 +283,14 @@ def format_summary(summary):
         add("mesh: {} collective folds, {} exchanges ({} moved)".format(
             mesh.get("folds", 0), mesh.get("exchanges", 0),
             _mb(mesh.get("exchange_bytes", 0))))
+    devx = summary.get("device", {})
+    if devx.get("device_stages") or devx.get("device_fraction"):
+        add("device: {} lowered stage(s) · device_fraction {:.2f} · "
+            "h2d {} · d2h {}".format(
+                devx.get("device_stages", 0),
+                devx.get("device_fraction", 0.0),
+                _mb(devx.get("h2d_bytes", 0)),
+                _mb(devx.get("d2h_bytes", 0))))
     dev = summary.get("devtime", {})
     if dev:
         add("devtime: device {:.2f}s · transfer {:.2f}s · codec {:.2f}s "

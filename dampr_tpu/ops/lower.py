@@ -1,0 +1,482 @@
+"""Jitted device programs for lowered map->fold stages.
+
+This is the execution half of the device-lowering pass
+(:mod:`dampr_tpu.plan.lower`): a fused per-record stage built from the
+native scanner vocabulary (``ops.text.TokenCounts`` / ``DocFreq``) feeding
+a keyed associative fold compiles into ONE jitted JAX program per shape
+bucket — DrJAX's blueprint (PAPERS.md, arXiv 2403.07128): the MapReduce
+primitive is *lowered through JAX*, not interpreted per record.
+
+Division of labor per line-aligned scan window:
+
+- **host (feed)**: byte classification + token bounds (the vectorized
+  table lookups from :mod:`.text`), case fold, per-line ids, and the
+  padded token byte matrix — the h2d payload, built for the NEXT batch
+  while the previous batch's program runs (double buffering);
+- **device (one program)**: dual-lane FNV hash of the matrix (byte-exact
+  with :mod:`.hashing`), stable sort by ``(validity, h1, h2[, line])``,
+  per-line first-occurrence dedup (DocFreq), segment counts via an
+  in-program prefix scan (or the Pallas fused segfold kernel when
+  ``settings.lower_pallas_segfold`` opts in), segment-representative
+  indices, and a collision check;
+- **host (drain)**: compact the vocabulary-sized survivors, decode their
+  representative strings from the original buffer, and build the Block
+  the normal fold/spill machinery consumes.
+
+Exactness contract: grouping is by the engine's 64-bit dual hash lanes,
+and the program *verifies* every record's token bytes equal its segment
+representative's bytes — any mismatch (a 64-bit collision) falls that
+batch back to the exact host grouping, so results are byte-identical to
+the host path by construction.  Windows that are not round-trip-clean
+UTF-8 (lossy-decode tokens would break the per-line set contract — see
+``text.chunk_doc_freq``) and lines longer than a program batch fall back
+whole, for the same reason.
+
+Per-batch partial counts merge in the downstream combiner exactly like
+the host scanners' per-window partials: the fold is associative, so
+batch boundaries are unobservable in the results.
+"""
+
+import functools
+
+import numpy as np
+
+from .. import settings
+from ..obs import trace as _trace
+from . import devtime
+from .text import (_LOWER, _SHORT_TOKEN, _token_bounds, chunk_doc_freq,
+                   chunk_token_counts)
+
+# ---------------------------------------------------------------------------
+# Stage claims: which mappers have a device lowering
+# ---------------------------------------------------------------------------
+
+
+def claims(mapper):
+    """Lowering params for a mapper the device programs can execute, or
+    None.  Exact types only — a subclass may have changed semantics the
+    program would silently miss."""
+    from .text import DocFreq, TokenCounts
+
+    if type(mapper) is TokenCounts:
+        if mapper.mode in ("word", "whitespace"):
+            return {"mode": mapper.mode, "lower": bool(mapper.lower),
+                    "dedup": False, "pair_values": bool(mapper.pair_values)}
+        return None
+    if type(mapper) is DocFreq:
+        if mapper.mode in ("word", "whitespace"):
+            return {"mode": mapper.mode, "lower": bool(mapper.lower),
+                    "dedup": True, "pair_values": bool(mapper.pair_values)}
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The jitted program
+# ---------------------------------------------------------------------------
+
+
+def _pow2(n):
+    return max(8, 1 << max(0, (n - 1).bit_length()))
+
+
+def _len_bucket(max_len):
+    from .hashing import _len_bucket as hb
+
+    return hb(max(1, int(max_len)))
+
+
+@functools.lru_cache(maxsize=None)
+def _token_fold_jit(n, L, dedup, pallas, interpret):
+    """One compiled program: hash -> sort -> dedup -> segment count ->
+    collision check over a padded [n, L] token byte matrix.  Cached per
+    shape bucket so recompilations stay bounded."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .hashing import _FNV_OFFSET1, _FNV_OFFSET2, _FNV_PRIME1, _FNV_PRIME2
+
+    def program(mat, lens, lines):
+        # -- dual-lane FNV over the byte columns (== hashing._fnv_jit) --
+        h1 = jnp.full((n,), _FNV_OFFSET1, dtype=jnp.uint32)
+        h2 = jnp.full((n,), _FNV_OFFSET2, dtype=jnp.uint32)
+
+        def body(c, hs):
+            a, b = hs
+            active = c < lens
+            byte = mat[:, c].astype(jnp.uint32)
+            na = (a ^ byte) * _FNV_PRIME1
+            nb = (b ^ byte) * _FNV_PRIME2
+            return (jnp.where(active, na, a), jnp.where(active, nb, b))
+
+        h1, h2 = lax.fori_loop(0, L, body, (h1, h2))
+
+        # -- stable sort by (validity, h1, h2[, line]) ------------------
+        inv = jnp.where(lens > 0, 0, 1).astype(jnp.int32)  # pad rows last
+        iota = jnp.arange(n, dtype=jnp.int32)
+        if dedup:
+            keys = (inv, h1, h2, lines.astype(jnp.int32), iota)
+            num_keys = 4
+        else:
+            keys = (inv, h1, h2, iota)
+            num_keys = 3
+        sorted_ = lax.sort(keys, num_keys=num_keys, is_stable=True)
+        sinv, sh1, sh2 = sorted_[0], sorted_[1], sorted_[2]
+        sline = sorted_[3] if dedup else None
+        perm = sorted_[-1]
+
+        def adj_new(*lanes):
+            """True where any lane differs from its predecessor (position
+            0 inclusive)."""
+            out = jnp.ones((n,), dtype=bool)
+            neq = jnp.zeros((n - 1,), dtype=bool)
+            for lane in lanes:
+                neq = neq | (lane[1:] != lane[:-1])
+            return out.at[1:].set(neq)
+
+        starts = adj_new(sinv, sh1, sh2)          # token segments
+        if dedup:
+            # contribution: first occurrence of (token, line) counts 1
+            v = jnp.where(adj_new(sinv, sh1, sh2, sline)
+                          & (sinv == 0), 1, 0).astype(jnp.int32)
+        else:
+            v = jnp.where(sinv == 0, 1, 0).astype(jnp.int32)
+
+        pos = jnp.arange(n, dtype=jnp.int32)
+        start_pos = lax.cummax(jnp.where(starts, pos, -1), axis=0)
+
+        use_pallas = pallas and n >= 8192 and n % 8192 == 0
+        if use_pallas:
+            from . import pallas_segfold as SF
+
+            tot, live = SF._segfold_call(n // SF._tile_elems(), interpret)(
+                sh1.astype(jnp.int32).reshape(-1, 128),
+                sh2.astype(jnp.int32).reshape(-1, 128),
+                v.reshape(-1, 128), sinv.reshape(-1, 128))
+            tot = tot.reshape(n)
+            live = live.reshape(n).astype(bool)
+        else:
+            csum = jnp.cumsum(v, dtype=jnp.int32)
+            ex = csum - v
+            # exclusive prefix at the segment start: ex is nondecreasing,
+            # so a running max over start-marked values carries it
+            start_ex = lax.cummax(jnp.where(starts, ex, -1), axis=0)
+            ends = jnp.ones((n,), dtype=bool).at[:-1].set(starts[1:])
+            tot = jnp.where(ends, csum - start_ex, 0)
+            live = ends & (sinv == 0)
+
+        # -- collision check: every token's bytes == its segment rep's --
+        smat = jnp.take(mat, perm, axis=0)
+        slens = jnp.take(lens, perm)
+        rep_rows = jnp.take(smat, start_pos, axis=0)
+        rep_lens = jnp.take(slens, start_pos)
+        same = (slens == rep_lens) & jnp.all(smat == rep_rows, axis=1)
+        collisions = jnp.sum(jnp.where((sinv == 0) & ~same, 1, 0))
+
+        rep_orig = jnp.take(perm, start_pos)  # original index of each rep
+        return sh1, sh2, tot, live, rep_orig, collisions
+
+    return jax.jit(program)
+
+
+def _lower_interpret():
+    """Pallas interpret mode is required off-TPU; resolve once."""
+    import jax
+
+    return jax.default_backend() not in ("tpu",)
+
+
+class _Batch(object):
+    """One dispatched program invocation plus the host metadata needed to
+    drain it: the window-local token starts/lens the reps decode from."""
+
+    __slots__ = ("out", "starts", "lens", "n")
+
+    def __init__(self, out, starts, lens, n):
+        self.out = out
+        self.starts = starts
+        self.lens = lens
+        self.n = n
+
+
+def _batch_bounds(lines, n_tokens, limit):
+    """Batch cut points (token indices) honoring line boundaries so the
+    per-line dedup never straddles a batch.  Returns None when a single
+    line exceeds the limit (caller falls back to the host path)."""
+    if n_tokens <= limit:
+        return [(0, n_tokens)]
+    cuts = [0]
+    at = 0
+    while at < n_tokens:
+        end = min(at + limit, n_tokens)
+        if end < n_tokens and lines is not None:
+            # retreat to the last token of the previous line
+            line_at_end = lines[end]
+            while end > at and lines[end - 1] == line_at_end:
+                end -= 1
+            if end == at:
+                return None  # one line wider than a whole batch
+        cuts.append(end)
+        at = end
+    return list(zip(cuts[:-1], cuts[1:]))
+
+
+class DeviceTokenFoldSink(object):
+    """Window-sink adapter running the lowered tokenize+hash+fold program
+    (drop-in for the scanners' ``window_sink()``).  ``add(win)`` feeds the
+    window through double-buffered program dispatches and yields resolved
+    partial-count Blocks; per-batch collision fallbacks and whole-window
+    host fallbacks keep results byte-identical to the host scanner."""
+
+    def __init__(self, params, store=None):
+        self.mode = params["mode"]
+        self.lower = params["lower"]
+        self.dedup = params["dedup"]
+        self.pair_values = params["pair_values"]
+        self.store = store
+        self.batches = 0
+        self.fallbacks = 0
+
+    # -- host fallbacks ----------------------------------------------------
+    def _host_window(self, win):
+        """Exact host path for one whole window (non-UTF-8 windows, lines
+        wider than a batch)."""
+        self.fallbacks += 1
+        if self.dedup:
+            blk = chunk_doc_freq(win, self.mode, self.lower,
+                                 self.pair_values)
+        else:
+            blk = chunk_token_counts(win, self.mode, self.lower,
+                                     self.pair_values)
+        return (blk,) if blk is not None and len(blk) else ()
+
+    def _host_batch(self, buf, starts, lens, lines):
+        """Exact host grouping for one collided batch: np.unique over
+        length-prefixed token byte rows — colliding hashes can never merge
+        distinct tokens.  MIRROR of text._numpy_counts_block's short-token
+        path (as _long_tokens mirrors its long path) parameterized on
+        precomputed bounds: a semantic change to either grouping MUST land
+        in both, or the equivalence suite's parity pins will catch it."""
+        from . import hashing
+
+        self.fallbacks += 1
+        n = len(starts)
+        L = int(lens.max())
+        idx = starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
+        np.clip(idx, 0, len(buf) - 1, out=idx)
+        mat = np.where(np.arange(L, dtype=np.int32)[None, :]
+                       < lens[:, None], buf[idx], 0)
+        rows = np.empty((n, L + 1), dtype=np.uint8)
+        rows[:, 0] = lens
+        rows[:, 1:] = mat
+        uniq, inverse = np.unique(rows, axis=0, return_inverse=True)
+        inverse = inverse.reshape(-1)
+        if self.dedup:
+            combined = lines.astype(np.int64) * len(uniq) + inverse
+            uc = np.unique(combined)
+            counts = np.bincount(uc % len(uniq), minlength=len(uniq))
+        else:
+            counts = np.bincount(inverse, minlength=len(uniq))
+        keys = np.empty(len(uniq), dtype=object)
+        for i in range(len(uniq)):
+            ln = int(uniq[i, 0])
+            keys[i] = uniq[i, 1:1 + ln].tobytes().decode("utf-8", "replace")
+        h1, h2 = hashing.hash_keys(keys)
+        return self._emit(keys, counts.astype(np.int64), h1, h2)
+
+    def _emit(self, keys, counts, h1, h2):
+        from ..blocks import Block
+
+        n = len(keys)
+        if self.pair_values:
+            vals = np.empty(n, dtype=object)
+            for i in range(n):
+                vals[i] = (keys[i], int(counts[i]))
+        else:
+            vals = np.asarray(counts, dtype=np.int64)
+        return Block(keys, vals, h1, h2)
+
+    # -- long tokens (host dict, window-scoped like the numpy path) --------
+    def _long_tokens(self, buf, starts, lens, line_id, long_idx):
+        from . import hashing
+
+        bb_get = buf.tobytes if len(long_idx) > 1024 else None
+        bb = bb_get() if bb_get else None
+        agg = {}
+        seen = set()
+        for i in long_idx:
+            s = int(starts[i])
+            ln = int(lens[i])
+            raw = (bb[s:s + ln] if bb is not None
+                   else buf[s:s + ln].tobytes())
+            tok = raw.decode("utf-8", "replace")
+            if self.dedup:
+                key = (int(line_id[i]), tok)
+                if key in seen:
+                    continue
+                seen.add(key)
+            agg[tok] = agg.get(tok, 0) + 1
+        keys = np.empty(len(agg), dtype=object)
+        counts = np.empty(len(agg), dtype=np.int64)
+        for i, (k, c) in enumerate(agg.items()):
+            keys[i] = k
+            counts[i] = c
+        h1, h2 = hashing.hash_keys(keys)
+        return self._emit(keys, counts, h1, h2)
+
+    # -- the pipeline ------------------------------------------------------
+    def _dispatch(self, buf, starts, lens, lines):
+        """Pad one batch to its shape bucket and launch the program; h2d
+        payload bytes are charged to the store's HBM counters."""
+        n = len(starts)
+        with devtime.track("codec"):
+            L = _len_bucket(lens.max())
+            npad = max(_pow2(n),
+                       8192 if settings.lower_pallas_segfold else 8)
+            idx = starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
+            np.clip(idx, 0, len(buf) - 1, out=idx)
+            mat = np.zeros((npad, L), dtype=np.uint8)
+            mat[:n] = np.where(np.arange(L, dtype=np.int32)[None, :]
+                               < lens[:, None], buf[idx], 0)
+            lens_p = np.zeros(npad, dtype=np.int32)
+            lens_p[:n] = lens
+            lines_p = np.zeros(npad, dtype=np.int32)
+            if lines is not None:
+                lines_p[:n] = lines
+        fn = _token_fold_jit(npad, L, self.dedup,
+                             settings.lower_pallas_segfold,
+                             _lower_interpret())
+        nbytes = mat.nbytes + lens_p.nbytes + lines_p.nbytes
+        if self.store is not None:
+            self.store.count_h2d(nbytes)
+        with devtime.track("device"), _trace.span(
+                "device", "map-fold", tokens=n, bytes=nbytes):
+            out = fn(mat, lens_p, lines_p)
+        self.batches += 1
+        return _Batch(out, starts, lens, n)
+
+    def _drain(self, buf, batch):
+        """Fetch one program's results and build the partial-count Block
+        (vocabulary-sized).  Collisions re-group the batch on host."""
+        with devtime.track("device"), _trace.span("device", "drain",
+                                                  tokens=batch.n):
+            sh1, sh2, tot, live, rep_orig, collisions = (
+                np.asarray(a) for a in batch.out)
+        if self.store is not None:
+            self.store.count_d2h(sh1.nbytes + sh2.nbytes + tot.nbytes
+                                 + live.nbytes + rep_orig.nbytes)
+        if int(collisions):
+            lines = None
+            if self.dedup:
+                # line ids were consumed by the program; rebuild them for
+                # the host regroup from the batch's token starts
+                lines = self._line_ids(buf, batch.starts)
+            return self._host_batch(buf, batch.starts, batch.lens, lines)
+        idx = np.flatnonzero(live)
+        if not len(idx):
+            return None
+        counts = tot[idx].astype(np.int64)
+        h1g = sh1[idx]
+        h2g = sh2[idx]
+        reps = rep_orig[idx]
+        keys = np.empty(len(idx), dtype=object)
+        starts, lens = batch.starts, batch.lens
+        for i, r in enumerate(reps):
+            s = int(starts[r])
+            keys[i] = buf[s:s + int(lens[r])].tobytes().decode(
+                "utf-8", "replace")
+        return self._emit(keys, counts, h1g, h2g)
+
+    def _line_ids(self, buf, starts):
+        nl = np.flatnonzero(buf == 10)
+        line_starts = np.concatenate(([0], nl + 1)).astype(np.int64)
+        return (np.searchsorted(line_starts, starts, side="right")
+                - 1).astype(np.int32)
+
+    def add(self, win):
+        data = bytes(win) if isinstance(win, memoryview) else win
+        buf = np.frombuffer(data, dtype=np.uint8)
+        if not len(buf):
+            return ()
+        if (buf > 127).any():
+            # Only valid-UTF-8 windows lower: token substrings of valid
+            # UTF-8 decode losslessly (boundaries are ASCII), so no
+            # U+FFFD substitution can desync keys from their raw-byte
+            # hash lanes or the per-line byte-dedup contract.  A strict
+            # decode attempt is the one-pass equivalent of the
+            # replace-decode round-trip test.
+            try:
+                data.decode("utf-8")
+            except UnicodeDecodeError:
+                return self._host_window(win)
+        with devtime.track("codec"):
+            if self.lower:
+                buf = _LOWER[buf]
+            starts, lens = _token_bounds(buf, self.mode)
+        n = len(starts)
+        if n == 0:
+            return ()
+        line_id = self._line_ids(buf, starts) if self.dedup else None
+
+        out = []
+        short = lens <= _SHORT_TOKEN
+        long_idx = np.flatnonzero(~short)
+        if len(long_idx):
+            blk = self._long_tokens(buf, starts, lens, line_id, long_idx)
+            if blk is not None and len(blk):
+                out.append(blk)
+            sidx = np.flatnonzero(short)
+            starts, lens = starts[sidx], lens[sidx]
+            line_id = line_id[sidx] if line_id is not None else None
+            n = len(starts)
+            if n == 0:
+                return out
+
+        bounds = _batch_bounds(line_id, n, max(1024, settings.lower_batch))
+        if bounds is None:
+            # The whole-window host path recounts EVERY token, long ones
+            # included — any partials staged in `out` must be discarded or
+            # long tokens would count twice.
+            return tuple(self._host_window(win))
+
+        # Double-buffered feed: build + dispatch batch i+1 while batch i's
+        # program runs; drain resolves the previous dispatch only after
+        # the next one is in flight (jax dispatch is async).
+        pending = None
+        for a, b in bounds:
+            nxt = self._dispatch(
+                buf, starts[a:b], lens[a:b],
+                line_id[a:b] if line_id is not None else None)
+            if pending is not None:
+                blk = self._drain(buf, pending)
+                if blk is not None and len(blk):
+                    out.append(blk)
+            pending = nxt
+        if pending is not None:
+            blk = self._drain(buf, pending)
+            if blk is not None and len(blk):
+                out.append(blk)
+        return out
+
+    def finish(self):
+        return ()
+
+
+def device_window_sink(mapper, store=None):
+    """The device window sink for a claimed mapper, or None."""
+    params = claims(mapper)
+    if params is None:
+        return None
+    return DeviceTokenFoldSink(params, store=store)
+
+
+def device_map_blocks(mapper, dataset, store=None):
+    """Lowered replacement for ``mapper.map_blocks``: drive the device
+    sink over the chunk's line-aligned windows (the SAME window driver as
+    the host scanners, so window boundaries — and therefore per-line
+    dedup scopes — are identical)."""
+    from .text import _drive_windows
+
+    return _drive_windows(mapper, dataset,
+                          sink=device_window_sink(mapper, store))

@@ -276,12 +276,15 @@ class _StatelessWindowSink(object):
         return ()
 
 
-def _drive_windows(mapper, dataset):
+def _drive_windows(mapper, dataset, sink=None):
     """Shared map_blocks body: run the mapper's window sink over the
     chunk's line-aligned windows.  The runner's scan-sharing group executor
     drives several sinks over ONE window pass instead (runner.py
-    run_map_group), so fused co-source stages read the tap once."""
-    sink = mapper.window_sink()
+    run_map_group), so fused co-source stages read the tap once.
+    ``sink`` overrides the mapper's own sink (the device-lowered scan,
+    ops.lower.device_map_blocks)."""
+    if sink is None:
+        sink = mapper.window_sink()
     for win in _scan_windows(dataset):
         for blk in sink.add(win) or ():
             yield blk

@@ -63,6 +63,27 @@ def init_distributed(coordinator_address=None, num_processes=None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+
+    # CPU-only deployments need an explicit cross-process collectives
+    # implementation: the XLA CPU client ships none by default ("
+    # Multiprocess computations aren't implemented on the CPU backend"),
+    # but jaxlib bundles gloo TCP collectives — selecting them here makes
+    # the same mesh programs span processes on plain CPUs (the tier-1
+    # two-process backend test runs exactly this path).  Guarded: older
+    # jax without the flag, or non-CPU platforms, are left untouched.
+    try:
+        platforms = jax.config.jax_platforms or ""
+        first = platforms.split(",")[0]
+        # Engage unless a non-CPU platform is explicitly selected: with
+        # platform auto-detection (JAX_PLATFORMS unset) the flag is still
+        # safe — it configures only the CPU client's collectives, which
+        # accelerator deployments never route through.
+        if (first in ("", "cpu")
+                and "jax_cpu_collectives_implementation"
+                in jax.config.values):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - best-effort; initialize() decides
+        pass
     jax.distributed.initialize(**kwargs)
 
 

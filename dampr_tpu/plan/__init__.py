@@ -40,13 +40,13 @@ that fired.  See ``docs/plan.md``.
 import time
 
 from .. import settings
-from . import cost, explain, ir, passes
+from . import cost, explain, ir, lower, passes
 from .explain import explain_text
 from .ir import graph_signature
 from .passes import optimize
 
 __all__ = ["optimize", "apply_to_runner", "explain_text", "graph_signature",
-           "ir", "passes", "cost", "explain"]
+           "ir", "passes", "cost", "explain", "lower"]
 
 
 def empty_report(graph, enabled):
@@ -60,6 +60,8 @@ def empty_report(graph, enabled):
         "fused": [],
         "dead": [],
         "adaptive": {"applied": False, "reason": "disabled"},
+        "lowering": lower.empty_section(False),
+        "device_stages": 0,
         "seconds": 0.0,
     }
 
@@ -86,6 +88,11 @@ def apply_to_runner(runner, outputs):
         graph, report = optimize(graph, outputs)
         runner.graph = graph
         cost.adapt(runner, graph, report)
+    # Device lowering runs on BOTH legs (a placement decision over
+    # whatever stage list executes, not a graph-shape rewrite): assign
+    # each stage its execution target, stats history pinning tiny stages
+    # to host.
+    lower.apply(runner, outputs, report)
     # Shape records ride into stats.json so the NEXT run's cost layer can
     # match its plan against this run's measurements.
     report["stage_shapes"] = ir.stage_shapes(getattr(runner, "graph", graph))

@@ -48,6 +48,21 @@ def load_history(run_name):
         return None
 
 
+def matched_history(run_name, graph):
+    """The prior run's summary, but only when its plan stage shapes match
+    ``graph`` — per-sid measurements are meaningless across shapes.  Used
+    by the lowering pass's stats-driven placement and by explain()."""
+    hist = load_history(run_name)
+    if hist is None:
+        return None
+    shapes_prev = (hist.get("plan") or {}).get("stage_shapes") or []
+    shapes_now = ir.stage_shapes(graph)
+    if ([s.get("shape") for s in shapes_prev]
+            != [s["shape"] for s in shapes_now]):
+        return None
+    return hist
+
+
 def _clamped_partitions(reduce_bytes):
     want = max(1, -(-int(reduce_bytes) // settings.plan_partition_bytes))
     floor = max(4, min(settings.max_processes, settings.partitions))

@@ -9,7 +9,7 @@ graph (``optimize`` is value-semantic).
 
 from .. import settings
 from ..graph import GInput
-from . import cost, ir, passes
+from . import cost, ir, lower, passes
 
 
 def _stage_lines(graph, indent="  "):
@@ -36,6 +36,7 @@ def explain_text(graph, outputs, name=None):
     if not settings.optimize:
         lines.append("optimizer OFF (settings.optimize / "
                      "DAMPR_TPU_OPTIMIZE=0): the plan above executes as-is")
+        lines.extend(_target_lines(graph, name, outputs))
         return "\n".join(lines)
     optimized, report = passes.optimize(graph, outputs)
     lines.append("== optimized plan ({} executed) =="
@@ -76,4 +77,25 @@ def explain_text(graph, outputs, name=None):
                         "    s{}: {}  {} rec / {} B out".format(
                             st.get("stage"), st.get("kind"),
                             st.get("records_out"), st.get("bytes_out")))
+    lines.extend(_target_lines(optimized, name, outputs))
     return "\n".join(lines)
+
+
+def _target_lines(graph, name, outputs=()):
+    """Per-stage execution targets (the device-lowering pass): which
+    stages compile to jitted device programs and why the rest stay host."""
+    lines = []
+    if not settings.lower_enabled():
+        lines.append("targets: device lowering off (settings.lower={!r}; "
+                     "every stage executes on host)".format(settings.lower))
+        return lines
+    decisions = lower.analyze(
+        graph, cost.matched_history(name, graph) if name else None,
+        outputs)
+    n_dev = sum(1 for d in decisions if d["target"] == "device")
+    lines.append("targets: {} of {} executed stages lowered to device "
+                 "programs".format(n_dev, len(decisions)))
+    for d in decisions:
+        lines.append("  s{}: {} -> {}  ({})".format(
+            d["sid"], d["kind"], d["target"], d["reason"]))
+    return lines

@@ -1,0 +1,216 @@
+"""The device-lowering pass: assign each executed stage an explicit
+execution target (``host`` | ``device``).
+
+Runs after the rewrite passes (and on the literal graph when the
+optimizer is off — lowering is a placement decision, not a graph-shape
+rewrite), inspecting each stage:
+
+- a **map** stage lowers when its fused chain is a native-vocabulary
+  scanner (:func:`dampr_tpu.ops.lower.claims` — the tokenize/hash
+  scanners) optionally followed by identity, its map-side combiner (if
+  any) is a device-foldable ``sum``, and its output feeds a keyed
+  associative fold — the fused map->fold shape the jitted programs
+  compile.  Everything else stays host with a recorded reason (opaque
+  UDFs are the guaranteed fallback: the lowerer never claims a stage it
+  cannot prove equivalent).
+- a **reduce** stage lowers when it is a device-foldable associative
+  fold (``sum``/``min``/``max``) — executed through the existing exact
+  segment kernels, which still fall back per block when 32-bit lanes
+  would truncate.
+
+Placement is stats-driven (the tf.data-service argument, arXiv
+2210.14826): a prior run's history showing a stage emitted fewer than
+``settings.lower_min_records`` records pins it to host — program
+dispatch overhead dominates tiny stages.  Per-stage kill switch: pass
+``lower=False`` in the stage's options (``custom_mapper(m,
+lower=False)``).  Master switch: ``settings.lower``
+(``DAMPR_TPU_LOWER``; results are byte-identical either way).
+
+Device-targeted stages gain ``options["exec_target"] = "device"`` on a
+fresh clone (shared nodes are never mutated); the full target map with
+reasons lands in the plan report's ``lowering`` section, rendered by
+``explain()`` and shipped in ``stats()["plan"]``.
+"""
+
+import logging
+
+from .. import base, settings
+from ..graph import GMap, GReduce
+from . import ir
+
+log = logging.getLogger("dampr_tpu.plan.lower")
+
+
+def _fold_kind(stage):
+    """The device-foldable combiner kind a stage carries, or None."""
+    op = None
+    if isinstance(getattr(stage, "combiner", None),
+                  base.PartialReduceCombiner):
+        op = stage.combiner.op
+    elif "binop" in (stage.options or {}):
+        from ..ops import segment
+
+        op = segment.as_assoc_op(stage.options["binop"])
+    return getattr(op, "kind", None)
+
+
+def _consumers_all_sum_folds(graph, output, protected, _depth=0):
+    """Does EVERY consumer of ``output`` (looking through bare
+    checkpoints) fold it with a keyed associative ``sum``?
+
+    The device programs emit partial counts at batch granularity where
+    the host scanner emits them at window granularity — only a summing
+    fold is invariant to that regrouping.  Any other consumer (an opaque
+    UDF branch, a min/max fold, a direct read of a requested output)
+    would OBSERVE the partial grouping, so the stage must stay host for
+    the legs to stay byte-identical."""
+    if _depth > len(graph.stages) or output in protected:
+        return False
+    consumers = [s for s in graph.stages
+                 if output in getattr(s, "inputs", ())]
+    if not consumers:
+        return False
+    for stage in consumers:
+        if isinstance(stage, GReduce):
+            red = getattr(stage, "reducer", None)
+            if (isinstance(red, base.AssocFoldReducer)
+                    and red.op.kind == "sum"):
+                continue
+            return False
+        if isinstance(stage, GMap) and ir.is_identity_mapper(stage.mapper):
+            kind = _fold_kind(stage)
+            if kind == "sum":
+                continue
+            if kind is None and not ir.has_combiner(stage):
+                # bare checkpoint: its consumers decide
+                if _consumers_all_sum_folds(graph, stage.output, protected,
+                                            _depth + 1):
+                    continue
+            return False
+        return False
+    return True
+
+
+def _map_decision(stage, graph, protected):
+    """(target, reason) for a GMap stage.  ``protected`` holds the
+    requested output Sources — a directly-read output exposes partial
+    granularity and never lowers without a combiner."""
+    from ..ops import lower as ops_lower
+
+    if (stage.options or {}).get("lower") is False:
+        return "host", "killed by stage option lower=False"
+    if len(stage.inputs) != 1:
+        return "host", "multi-input map (join shapes stay host)"
+    leaves = ir.flatten_mapper(stage.mapper)
+    head, tail = leaves[0], leaves[1:]
+    params = ops_lower.claims(head)
+    if params is None:
+        name = ir._part_name(head)
+        return "host", "no device lowering for {} (opaque UDF)".format(name)
+    bad = [p for p in tail if not (type(p) is base.Map
+                                   and p.mapper is base._identity)]
+    if bad:
+        return "host", "post-scan ops not in the device vocabulary: " + \
+            ", ".join(ir._part_name(p) for p in bad)
+    kind = _fold_kind(stage)
+    if ir.has_combiner(stage) and kind != "sum":
+        # A non-sum combiner folds partials whose grouping differs
+        # between the host (per window) and device (per batch) scans.
+        return "host", "combiner kind {!r} not sum — partial-count " \
+            "granularity would be observable".format(kind)
+    if kind != "sum" and not _consumers_all_sum_folds(
+            graph, stage.output, protected):
+        return "host", "not every consumer is a keyed sum fold — " \
+            "partial-count granularity would be observable"
+    return "device", "scanner {} + keyed sum fold compile to one jitted " \
+        "program".format(type(head).__name__)
+
+
+def _reduce_decision(stage):
+    if (stage.options or {}).get("lower") is False:
+        return "host", "killed by stage option lower=False"
+    red = getattr(stage, "reducer", None)
+    if not isinstance(red, base.AssocFoldReducer):
+        name = ir._part_name(red) if red is not None else "?"
+        return "host", "non-associative reducer {} (opaque UDF)".format(name)
+    if red.op.kind not in ("sum", "min", "max"):
+        return "host", "fold binop has no device kind (opaque Python binop)"
+    return "device", "assoc {} fold runs the device segment kernels " \
+        "(exact 32-bit-lane gate per block)".format(red.op.kind)
+
+
+def analyze(graph, history=None, outputs=()):
+    """Per-executed-stage target decisions: [{sid, kind, target, reason}].
+
+    ``history`` (a prior run's stats.json summary, shape-matched by the
+    caller) drives the stats placement gate; ``outputs`` are the Sources
+    the caller will read directly."""
+    by_sid = {}
+    if history:
+        by_sid = {s.get("stage"): s for s in history.get("stages", [])}
+    protected = set(outputs)
+    decisions = []
+    for sid, stage in enumerate(graph.stages):
+        kind = ir.stage_kind(stage)
+        if kind == "input":
+            continue
+        if kind == "map":
+            target, reason = _map_decision(stage, graph, protected)
+        elif kind == "reduce":
+            target, reason = _reduce_decision(stage)
+        else:
+            target, reason = "host", "sinks drain through the normal " \
+                "spill/store machinery"
+        if target == "device":
+            st = by_sid.get(sid) or {}
+            recs = st.get("records_out")
+            if recs is not None and recs < settings.lower_min_records:
+                target, reason = "host", (
+                    "history: {} records < lower_min_records={} — dispatch "
+                    "overhead dominates".format(
+                        recs, settings.lower_min_records))
+        decisions.append({"sid": sid, "kind": kind, "target": target,
+                          "reason": reason})
+    return decisions
+
+
+def empty_section(enabled):
+    return {"enabled": enabled, "targets": [], "device_stages": 0}
+
+
+def apply(runner, outputs, report):
+    """Annotate ``runner.graph`` with execution targets and record the
+    decision map in ``report["lowering"]`` (+ ``report["device_stages"]``,
+    the count the stats section surfaces).  Value-semantic: only stages
+    that lower get fresh clones; with lowering off (or nothing eligible)
+    the graph object is untouched.  History loads lazily — the disabled
+    path (CPU default) never touches the stats file."""
+    graph = getattr(runner, "graph", None)
+    report["lowering"] = empty_section(False)
+    report["device_stages"] = 0
+    if graph is None or not hasattr(graph, "stages"):
+        return
+    if not settings.lower_enabled():
+        report["lowering"]["reason"] = (
+            "off (settings.lower={!r}; DAMPR_TPU_LOWER forces it)"
+            .format(settings.lower))
+        return
+    from . import cost
+
+    history = cost.matched_history(getattr(runner, "name", None), graph)
+    decisions = analyze(graph, history, outputs)
+    section = report["lowering"]
+    section["enabled"] = True
+    section["targets"] = decisions
+    lowered = {d["sid"] for d in decisions if d["target"] == "device"}
+    section["device_stages"] = len(lowered)
+    report["device_stages"] = len(lowered)
+    if not lowered:
+        return
+    stages = list(graph.stages)
+    for sid in lowered:
+        opts = dict(stages[sid].options or {})
+        opts["exec_target"] = "device"
+        stages[sid] = ir.clone_with_options(stages[sid], opts)
+    runner.graph = ir.rebuilt(stages)
+    log.info("plan: %d stage(s) lowered to device programs", len(lowered))

@@ -680,11 +680,16 @@ class StageStats(object):
 
     __slots__ = ("stage_id", "kind", "n_jobs", "records_in", "records_out",
                  "bytes_in", "bytes_out", "spill_count", "spill_bytes",
-                 "merge_gens", "merge_gen_bytes", "retries", "seconds")
+                 "merge_gens", "merge_gen_bytes", "retries", "seconds",
+                 "target")
 
     def __init__(self, stage_id, kind):
         self.stage_id = stage_id
         self.kind = kind
+        # Execution target the plan's lowering pass assigned ("host" |
+        # "device"); device map stages ran the jitted tokenize+hash+fold
+        # programs, device reduces the segment kernels.
+        self.target = "host"
         self.n_jobs = 0
         self.records_in = 0
         self.records_out = 0
@@ -699,6 +704,7 @@ class StageStats(object):
 
     def as_dict(self):
         return {"stage": self.stage_id, "kind": self.kind,
+                "target": self.target,
                 "jobs": self.n_jobs,
                 "records_in": self.records_in,
                 "records_out": self.records_out,
@@ -849,7 +855,7 @@ class MTRunner(object):
                 chunks = [BlockDataset(refs)]
 
         (job, combine_op, pin, feeds_reduce, _new_sink,
-         feeds_dev, run_mode) = self._map_job_factory(
+         feeds_dev, run_mode, _wsink) = self._map_job_factory(
             stage, supplementary)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
@@ -1047,8 +1053,10 @@ class MTRunner(object):
                 members = []
                 for i, s in enumerate(stages):
                     push, end = factories[i][4]()
-                    members.append(
-                        (_clone_op(s.mapper).window_sink(), push, end))
+                    # factories[i][7] is the target-aware window-sink
+                    # factory: device-lowered members scan through the
+                    # jitted programs, host members keep their own sink.
+                    members.append((factories[i][7](), push, end))
 
                 def codec():
                     # ONE sequential window pass drives every member's
@@ -1084,7 +1092,7 @@ class MTRunner(object):
         ret = []
         for i in range(len(stages)):
             (_job, combine_op, pin, feeds_reduce, _new_sink,
-             feeds_dev, run_mode) = factories[i]
+             feeds_dev, run_mode, _wsink) = factories[i]
             pset = self._collect_partitions(
                 [outs[i] for outs in results], combine_op, pin, feeds_reduce,
                 device=feeds_dev, sorted_runs=run_mode)
@@ -1219,6 +1227,27 @@ class MTRunner(object):
         # batch_size option from observed bytes/record history.
         stage_batch = stage.options.get("batch_size") or settings.batch_size
 
+        # Device-lowered stage (plan.lower assigned exec_target): the
+        # scanner's window pass runs through the jitted tokenize+hash+fold
+        # programs instead of the host codec.  claims() re-checks the
+        # mapper so a stale/foreign annotation can never dispatch an
+        # unrecognized op — the host path below is the guaranteed fallback.
+        dev_lowered = False
+        if stage.options.get("exec_target") == "device":
+            from .ops import lower as ops_lower
+
+            dev_lowered = ops_lower.claims(stage.mapper) is not None
+
+        def window_sink():
+            """The stage's window sink honoring its execution target
+            (shared with run_map_group's fused window pass)."""
+            if dev_lowered:
+                from .ops import lower as ops_lower
+
+                return ops_lower.device_window_sink(
+                    _clone_op(stage.mapper), self.store)
+            return _clone_op(stage.mapper).window_sink()
+
         def job(chunk):
             mapper = _clone_op(stage.mapper)
             builder = BlockBuilder(stage_batch)
@@ -1244,7 +1273,20 @@ class MTRunner(object):
                      if settings.batch_udf and not supplementary
                      and not use_blocks and not ident_blocks else None)
             push, end = new_sink()
-            if use_blocks:
+            if (dev_lowered and not supplementary
+                    and (hasattr(chunk, "read_bytes")
+                         or hasattr(chunk, "iter_byte_blocks"))):
+                # Device-lowered scan: windows feed double-buffered jitted
+                # programs (ops.lower); the producer thread tokenizes and
+                # dispatches while this thread folds/registers the
+                # vocabulary-sized partials.
+                from .ops.lower import device_map_blocks
+
+                for blk in _overlap_stream(
+                        device_map_blocks(mapper, chunk, self.store),
+                        self.store):
+                    push(blk)
+            elif use_blocks:
                 # Stage-overlapped streaming executor: the codec (window
                 # scan + tokenize/parse inside map_blocks) runs ahead on
                 # its own thread while this thread folds/registers, with
@@ -1325,7 +1367,7 @@ class MTRunner(object):
             return end()
 
         return (job, combine_op, pin, feeds_reduce, new_sink,
-                feeds_device_fold, sorted_run_mode)
+                feeds_device_fold, sorted_run_mode, window_sink)
 
     def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True,
                             device=False):
@@ -2294,6 +2336,24 @@ class MTRunner(object):
                 "exchanges": self.mesh_exchanges,
                 "exchange_bytes": self.mesh_exchange_bytes,
             },
+            # Device execution: run-wide device counters — device_fraction
+            # is thread-seconds inside ANY jitted kernel (lowered programs,
+            # segment folds, the hash lexsort, mesh collectives) over wall,
+            # and h2d/d2h aggregate the lowered-program feed/drain WITH the
+            # HBM tier's puts/fetches.  device_stages is the
+            # lowering-specific signal: how many stages the plan placed on
+            # device this run.
+            "device": {
+                "device_fraction": (round(dev.get("device", 0.0) / wall, 4)
+                                    if wall > 0 else 0.0),
+                "device_seconds": round(dev.get("device", 0.0), 4),
+                "h2d_bytes": sto.h2d_bytes,
+                "d2h_bytes": sto.d2h_bytes,
+                "device_stages": (self.plan_report or {}).get(
+                    "device_stages", 0),
+                "lowered": bool(((self.plan_report or {}).get("lowering")
+                                 or {}).get("enabled")),
+            },
             "streamed_assoc_folds": self.streamed_assoc_folds,
             "retries": self.retries_total,
             # The logical plan that executed: stages before/after the
@@ -2563,6 +2623,7 @@ class MTRunner(object):
                 if _resume.is_volatile(stage_fps[sid]):
                     volatile_sources.add(stage.output)
             st = StageStats(sid, kind)
+            st.target = (stage.options or {}).get("exec_target", "host")
             st.n_jobs = njobs
             st.records_out = nrec
             st.seconds = time.time() - t0

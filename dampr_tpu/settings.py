@@ -308,6 +308,69 @@ plan_block_bytes = int(os.environ.get(
 seed = (int(os.environ["DAMPR_TPU_SEED"])
         if os.environ.get("DAMPR_TPU_SEED") else None)
 
+# ---------------------------------------------------------------------------
+# Device lowering (dampr_tpu.plan.lower + dampr_tpu.ops.lower)
+# ---------------------------------------------------------------------------
+
+#: Master switch for the device-lowering pass: fused map->fold stages
+#: whose operators come from the native vocabulary (ops.text scanners
+#: feeding keyed associative folds) compile into ONE jitted JAX program —
+#: tokenize bounds on host, hash + dedup + segment fold on the default
+#: JAX backend — instead of the host codec path.  "on"/"1" force it,
+#: "off"/"0" disable it, "auto" (default) enables it whenever a
+#: non-CPU accelerator backend is attached (on CPU-only hosts the native
+#: C codec measures faster, so auto keeps the host path; CI forces the
+#: CPU-JAX jit leg with DAMPR_TPU_LOWER=1).  Results are byte-identical
+#: either way — ineligible stages and non-byte inputs always keep the
+#: host path, and the program's collision check falls back per batch.
+lower = os.environ.get("DAMPR_TPU_LOWER", "auto")
+
+_resolved_lower = None
+
+
+def lower_enabled():
+    """Is device lowering in force?  Auto resolves by backend the same
+    way the HBM tier does (never through a possibly-unhealthy remote
+    tunnel)."""
+    global _resolved_lower
+    s = str(lower).lower()
+    if s in ("on", "1", "true", "yes"):
+        return True
+    if s in ("off", "0", "false", "no"):
+        return False
+    if _resolved_lower is None:
+        if os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+            _resolved_lower = False
+        else:
+            import jax
+
+            _resolved_lower = jax.default_backend() != "cpu"
+    return _resolved_lower
+
+
+#: Tokens per device program dispatch: each line-aligned scan window is
+#: fed to the jitted program in batches of at most this many tokens
+#: (padded to a power of two so compilations stay bounded).  Bounds the
+#: padded token matrix the h2d feed stages while the previous batch's
+#: program runs (the double-buffered overlap).
+lower_batch = int(os.environ.get("DAMPR_TPU_LOWER_BATCH", str(1 << 18)))
+
+#: Stats-driven placement floor: when a prior run's stats history shows
+#: a lowered stage emitted fewer records than this, the cost layer
+#: places it back on host — program dispatch overhead dominates tiny
+#: stages (the tf.data-service argument: recorded stats pick host vs
+#: device per stage).
+lower_min_records = int(os.environ.get(
+    "DAMPR_TPU_LOWER_MIN_RECORDS", "4096"))
+
+#: Route the lowered program's segment-count step through the Pallas
+#: fused segfold kernel (ops/pallas_segfold.py) instead of the XLA scan
+#: lowering.  Off by default until benchmarks/pallas_bench.py measures a
+#: win on real hardware (the FNV Pallas kernel measured 0.58x and is NOT
+#: dispatched; same discipline here).
+lower_pallas_segfold = os.environ.get(
+    "DAMPR_TPU_LOWER_PALLAS", "0").lower() not in ("0", "false", "no", "off")
+
 #: Spill compression policy: "auto" (default) compresses object-lane
 #: blocks and writes fully-numeric blocks raw (high-entropy lanes don't
 #: compress and the codec pass is core-bound both ways); "always"/"never"

@@ -537,6 +537,12 @@ class RunStore(object):
         with self._lock:
             self.d2h_bytes += n
 
+    def count_h2d(self, n):
+        """Feed bytes shipped to device outside the HBM-tier register path
+        (the lowered map programs' padded token matrices)."""
+        with self._lock:
+            self.h2d_bytes += n
+
     def count_spill_read(self, nbytes, secs):
         with self._lock:
             self.spill_read_bytes += nbytes

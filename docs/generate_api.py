@@ -27,6 +27,7 @@ MODULES = [
     "dampr_tpu.plan.passes",
     "dampr_tpu.plan.cost",
     "dampr_tpu.plan.explain",
+    "dampr_tpu.plan.lower",
     "dampr_tpu.runner",
     "dampr_tpu.storage",
     "dampr_tpu.io",
@@ -46,6 +47,7 @@ MODULES = [
     "dampr_tpu.ops.hashing",
     "dampr_tpu.ops.segment",
     "dampr_tpu.ops.text",
+    "dampr_tpu.ops.lower",
     "dampr_tpu.parallel",
     "dampr_tpu.parallel.shuffle",
     "dampr_tpu.parallel.sgd",

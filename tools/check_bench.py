@@ -140,6 +140,14 @@ def main(argv=None):
 
     metric = report["metric"] or args.metric_key
     print("check_bench: {} fresh={:.4g}".format(metric, report["fresh"]))
+    # Device-execution shape (informational, never gated): where the
+    # plan placed stages and what the host moved to feed them.
+    if fresh.get("device_stages") is not None:
+        print("check_bench: device: {} lowered stage(s), "
+              "device_fraction={}, h2d={}, d2h={}".format(
+                  fresh.get("device_stages"),
+                  fresh.get("device_fraction"),
+                  fresh.get("h2d_bytes"), fresh.get("d2h_bytes")))
     for p in report["skipped"]:
         print("check_bench: note: {} has no comparable measurement, "
               "skipped".format(p))
