@@ -16,6 +16,11 @@ canonical workloads run from an installed package without a repo checkout.
   Prometheus text exposition, and a run directory containing a
   ``crashdump.json`` (the flight recorder's death artifact) makes the
   command exit 3 so scripts detect failed runs.
+- ``dampr-tpu-doctor`` — ranked bottleneck diagnosis for a completed run
+  (critical-path verdicts + per-op profile + history corpus -> concrete
+  settings suggestions); ``--diff A B`` compares two runs, ``--json``
+  emits the machine report (``docs/doctor_schema.json``).  See
+  :mod:`dampr_tpu.obs.doctor`.
 
 ``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` take ``--progress`` for the live
 in-run status line (``settings.progress``) and ``--explain`` to print the
@@ -120,6 +125,14 @@ def tf_idf():
         _print_stats(em)
 
 
+def doctor():
+    """Ranked bottleneck diagnosis for a completed run (see
+    dampr_tpu.obs.doctor)."""
+    from .obs.doctor import main
+
+    raise SystemExit(main())
+
+
 def _report_crashdump(dump):
     """Describe a flight-recorder crash dump on stderr (the non-zero
     exit's why)."""
@@ -192,6 +205,12 @@ def stats():
     else:
         print("stats: {}".format(path))
         print(export.format_summary(summary))
+    if (not args.prom and not args.json and dump is None
+            and summary.get("critpath")):
+        run_verdict = (summary["critpath"].get("run") or {}).get("verdict")
+        if run_verdict:
+            print("bottleneck: {}  (run `dampr-tpu-doctor {}` for the "
+                  "full diagnosis)".format(run_verdict, args.run))
     if args.series:
         tf = summary.get("trace_file")
         if not tf or not os.path.isfile(tf):

@@ -49,14 +49,21 @@ class Source(object):
 
 class StageNode(object):
     """Base for graph stage nodes; `options` carries per-op overrides
-    (n_maps/n_reducers/memory/binop — reference runner.py:285/331)."""
+    (n_maps/n_reducers/memory/binop — reference runner.py:285/331).
 
-    __slots__ = ("inputs", "output", "options")
+    ``_provenance`` is observability metadata, not plan semantics: the
+    fusion passes record the ORIGINAL user-stage descriptions a fused
+    node absorbed (an attribute rather than an options entry so resume
+    fingerprints — which hash options — are unaffected), and the
+    per-operator profiler reports against it."""
+
+    __slots__ = ("inputs", "output", "options", "_provenance")
 
     def __init__(self, inputs, output, options=None):
         self.inputs = list(inputs)
         self.output = output
         self.options = options or {}
+        self._provenance = None
 
 
 class GInput(StageNode):

@@ -97,9 +97,35 @@ last/peak, histogram summaries) including the sampler's self-accounting:
 sample count, series drops, and the ``overhead`` self-metric (sampler
 wall / run wall — the plane measures its own cost).
 
+**The diagnosis layer** (attribution + analysis over everything above):
+
+- **per-operator profiler** (:mod:`.profile`, ``settings.profile`` /
+  ``DAMPR_TPU_PROFILE=1``): fused stages attribute wall time and record
+  counts to the INDIVIDUAL user ops they were built from (plan fusion
+  rides provenance on the fused node); device stages decompose into
+  build/h2d/compute/d2h sub-phases; ``stats()`` gains a ``profile``
+  section with per-stage coverage.  Off = one None-check per site,
+  hoisted to one per job in the hot loops.
+- **critical-path analysis** (:mod:`.critpath`): walks the span
+  timeline and names the resource that bounds each stage's wall window
+  (codec / fold / spill-queue / io-read / merge / device / transfer /
+  overlap-stall / mesh / host-compute) via wall-clock interval unions —
+  ``stats()["critpath"]`` carries a dominant-bottleneck verdict per
+  executed stage and for the whole run.
+- **run-history corpus** (:mod:`.history`): every finalized run appends
+  one compact record (plan fingerprint + shapes, per-stage IO, critpath
+  verdicts, per-op profile, settings snapshot) to a bounded, crash-safe
+  JSONL under ``<scratch_root>/<run>/history.jsonl``; ``plan/cost.py``
+  adapts from medians over matching runs instead of one stats.json.
+- **doctor** (:mod:`.doctor`, ``dampr-tpu-doctor``): reads a run's
+  artifacts back and prints a ranked diagnosis — each finding ties a
+  bottleneck verdict to concrete ``settings`` knobs; ``--diff A B``
+  compares runs, ``--json`` emits the ``docs/doctor_schema.json``
+  report.
+
 The consolidated guide — schemas, Perfetto counter-track how-to,
-Prometheus scrape example, crashdump shape, the CI perf gate — is
-``docs/observability.md``.
+Prometheus scrape example, crashdump shape, the diagnosis taxonomy,
+the CI perf gate — is ``docs/observability.md``.
 
 For a profiler-grade XLA kernel timeline (HLO names, TPU counters) use
 the existing escape hatch instead: ``settings.profile_dir`` wraps the

@@ -1,0 +1,552 @@
+"""``dampr-tpu-doctor``: turn a run's telemetry into a ranked diagnosis.
+
+The obs plane records what happened (spans, counters), the critical-path
+analyzer says what bound each stage (:mod:`.critpath`), the profiler
+says which user op the time went to (:mod:`.profile`), and the history
+corpus says how that compares to previous runs (:mod:`.history`).  This
+module is the layer that reads all of it back and answers the operator's
+actual question: *why was this run slow, and which knob do I turn?*
+
+Every finding ties a bottleneck verdict to CONCRETE settings that exist
+in :mod:`dampr_tpu.settings` (the suggestion table is asserted against
+the module at import time in tests), ranked by estimated wall-time
+impact::
+
+    $ dampr-tpu-doctor /tmp/dampr_tpu/bench-tfidf
+    run bench-tfidf: 12.41s wall · bottleneck: codec
+    1. [high] stage 1 (map, 8.2s): codec-bound (0.61 of stage wall)
+       -> try DAMPR_TPU_LOWER=1 (settings.lower): this scanner stage is
+          device-eligible; the jitted program moves tokenize+fold off host
+    ...
+
+``--diff A B`` compares two runs (wall, per-stage seconds, verdicts,
+settings snapshots from the history corpus).  ``--json`` emits the
+machine-readable report (schema ``dampr-tpu-doctor/1``, checked in as
+``docs/doctor_schema.json`` and validated in CI by the dependency-free
+``tools/validate_doctor.py``).
+"""
+
+import json
+
+from .. import settings
+
+SCHEMA = "dampr-tpu-doctor/1"
+
+#: Bottleneck taxonomy -> settings suggestions.  Every entry names a knob
+#: that EXISTS in dampr_tpu.settings (pinned by tests) plus its env var
+#: and a why; ``suggest`` computes a proposed value from the current one.
+_PLAYBOOK = {
+    "spill-queue": [
+        ("spill_write_threads", "DAMPR_TPU_SPILL_WRITERS",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "spill writes queue behind the writer pool — more writer "
+         "threads drain the backlog before folds block on it"),
+        ("spill_inflight_bytes", "DAMPR_TPU_SPILL_INFLIGHT",
+         lambda cur: None,
+         "raise the queued-spill byte cap (default budget/2) so "
+         "admission stops throttling the fold side"),
+    ],
+    "io-read": [
+        ("spill_read_prefetch", "DAMPR_TPU_SPILL_PREFETCH",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "merge/final reads outran the frame prefetcher — deeper "
+         "readahead overlaps decode with consumption"),
+        ("spill_read_threads", "DAMPR_TPU_SPILL_READ_THREADS",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "more frame-read threads decode sibling runs in parallel"),
+    ],
+    "overlap-stall": [
+        ("overlap_windows", "DAMPR_TPU_OVERLAP_WINDOWS",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "every live fold consumer was blocked on its codec producer — "
+         "deeper overlap windows keep folds fed"),
+    ],
+    "codec": [
+        ("lower", "DAMPR_TPU_LOWER",
+         lambda cur: "1",
+         "host decode/tokenize bounds the stage and it is "
+         "device-eligible — the jitted program moves tokenize+hash+fold "
+         "off the host codec"),
+        ("scan_window_bytes", "",
+         lambda cur: None,
+         "larger line-aligned scan windows amortize per-window codec "
+         "fixed costs (at the cost of window-sized RSS)"),
+    ],
+    "fold": [
+        ("mesh_fold", "DAMPR_TPU_MESH_FOLD",
+         lambda cur: "on",
+         "map-side folds bound the stage — the mesh collective fold "
+         "path spreads keyed folds across devices"),
+    ],
+    "merge": [
+        ("merge_fanin", "DAMPR_TPU_MERGE_FANIN",
+         lambda cur: max(64, int(cur or 0) * 2),
+         "merge generations bound the run — higher fan-in merges more "
+         "runs per pass (memory budget permitting)"),
+        ("spill_read_prefetch", "DAMPR_TPU_SPILL_PREFETCH",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "deeper frame readahead keeps the k-way merge fed"),
+    ],
+    "spill-write": [
+        ("max_memory_per_stage", "",
+         lambda cur: int(cur or 0) * 2,
+         "spill disk bandwidth bounds the run — a larger stage budget "
+         "spills fewer bytes in the first place"),
+        ("spill_codec", "DAMPR_TPU_SPILL_CODEC",
+         lambda cur: "zstd" if str(cur) != "zstd" else "lz4",
+         "a faster/denser frame codec moves fewer bytes through the "
+         "same disk"),
+    ],
+    "transfer": [
+        ("lower_batch", "DAMPR_TPU_LOWER_BATCH",
+         lambda cur: int(cur or 0) * 2,
+         "h2d/d2h movement bounds device stages — larger program "
+         "batches amortize per-dispatch transfer"),
+        ("hbm_budget", "DAMPR_TPU_HBM_BUDGET",
+         lambda cur: None,
+         "a larger HBM residency budget keeps reduce-feeding lanes on "
+         "device instead of round-tripping"),
+    ],
+    "device": [
+        ("lower_batch", "DAMPR_TPU_LOWER_BATCH",
+         lambda cur: int(cur or 0) * 2,
+         "device programs bound the run — larger batches amortize "
+         "dispatch overhead per token"),
+    ],
+    "host-compute": [
+        ("max_processes", "",
+         lambda cur: None,
+         "uninstrumented host work (opaque UDFs / Python glue) bounds "
+         "the stage — profile it (DAMPR_TPU_PROFILE=1) to see which op, "
+         "and check worker-thread width"),
+    ],
+    "mesh": [
+        ("shuffle_capacity_factor", "",
+         lambda cur: None,
+         "collective exchanges bound the run — tune exchange capacity "
+         "or keep the shuffle on host (DAMPR_TPU_MESH_EXCHANGE=off)"),
+    ],
+}
+
+#: Verdicts that never produce a finding on their own.
+_BENIGN = ("idle", "checkpoint")
+
+
+class DoctorError(Exception):
+    pass
+
+
+def _severity(impact_seconds, wall):
+    if wall <= 0:
+        return "low"
+    frac = impact_seconds / wall
+    if frac >= 0.25:
+        return "high"
+    if frac >= 0.10:
+        return "medium"
+    return "low"
+
+
+def _run_settings(summary, hist_records):
+    """The DIAGNOSED RUN's settings values, not the doctor process's:
+    the history corpus snapshots the performance knobs per run, and the
+    summary itself records the authoritative ones (io.writer_threads,
+    overlap.windows, the sampler cadence).  A doctor invoked in a
+    different environment must not compute 'current -> suggested' from
+    its own defaults."""
+    out = dict((hist_records[-1].get("settings") or {})
+               if hist_records else {})
+    io = summary.get("io") or {}
+    if io.get("writer_threads") is not None:
+        out["spill_write_threads"] = io["writer_threads"]
+    if io.get("read_prefetch") is not None:
+        out["spill_read_prefetch"] = io["read_prefetch"]
+    ov = summary.get("overlap") or {}
+    if ov.get("windows") is not None:
+        out["overlap_windows"] = ov["windows"]
+    sm = (summary.get("metrics") or {}).get("sampler") or {}
+    if sm.get("interval_ms"):
+        out["metrics_interval_ms"] = sm["interval_ms"]
+    dev = summary.get("device") or {}
+    if dev.get("lowered") is not None:
+        out.setdefault("lower", "1" if dev["lowered"] else str(
+            settings.lower))
+    return out
+
+
+def _suggestions_for(verdict, summary, stage_entry=None,
+                     run_settings=None):
+    out = []
+    run_settings = run_settings or {}
+    for knob, env, propose, why in _PLAYBOOK.get(verdict, ()):
+        if not hasattr(settings, knob):
+            continue  # playbook drift: never suggest a knob that's gone
+        if verdict == "codec" and knob == "lower":
+            # Only suggest lowering when an eligible host stage exists:
+            # the plan report records per-stage decisions with reasons.
+            if not _has_lowerable_host_stage(summary, stage_entry):
+                continue
+        cur = (run_settings[knob] if knob in run_settings
+               else getattr(settings, knob))
+        try:
+            proposed = propose(cur)
+        except (TypeError, ValueError):
+            proposed = None
+        sug = {
+            "setting": knob,
+            "current": cur if _jsonable(cur) else str(cur),
+            "suggested": proposed if _jsonable(proposed) else str(proposed),
+            "why": why,
+        }
+        if env:  # omitted (not null) when the knob has no env var —
+            sug["env"] = env  # the schema types env as a string
+        out.append(sug)
+    return out
+
+
+def _jsonable(v):
+    return v is None or isinstance(v, (bool, int, float, str))
+
+
+def _has_lowerable_host_stage(summary, stage_entry=None):
+    """Is there a host-executed map stage the lowering pass would (or
+    could) place on device?  True when lowering was off entirely, or a
+    host decision's reason shows eligibility was only blocked by
+    history/settings."""
+    lowering = ((summary.get("plan") or {}).get("lowering")) or {}
+    if not lowering.get("enabled"):
+        # Lowering never ran (off / auto-off on CPU): a codec-bound
+        # scanner stage MAY be eligible — worth the suggestion.
+        return True
+    sids = None
+    if stage_entry is not None and stage_entry.get("stage") is not None:
+        sids = {stage_entry["stage"]}
+    for d in lowering.get("targets") or ():
+        if sids is not None and d.get("sid") not in sids:
+            continue
+        if d.get("target") == "host" and "history:" in (d.get("reason")
+                                                        or ""):
+            return True
+    return False
+
+
+def _stage_kind(summary, sid):
+    for st in summary.get("stages") or ():
+        if st.get("stage") == sid:
+            return st
+    return {}
+
+
+def diagnose(run):
+    """Build the full report dict for one run (name / run dir / stats
+    path).  Raises DoctorError when no stats exist."""
+    from . import critpath, flightrec, history
+
+    section, summary = critpath.from_run(run)
+    if summary is None:
+        raise DoctorError(
+            "no stats.json found for {!r}: doctor reads a finalized "
+            "run's artifacts (traced runs persist them — "
+            "DAMPR_TPU_TRACE=1)".format(run))
+    wall = summary.get("wall_seconds") or 0.0
+    hist = history.load(summary.get("run"))
+    run_settings = _run_settings(summary, hist)
+    findings = []
+
+    # -- per-stage verdicts --------------------------------------------------
+    stage_entries = []
+    for s in (section or {}).get("stages") or ():
+        sid = s.get("stage")
+        st = _stage_kind(summary, sid)
+        entry = {
+            "stage": sid,
+            "kind": s.get("kind") or st.get("kind"),
+            "target": st.get("target", "host"),
+            "seconds": s.get("seconds"),
+            "verdict": s.get("verdict"),
+            "fractions": s.get("fractions") or {},
+        }
+        stage_entries.append(entry)
+        verdict = s.get("verdict")
+        if verdict in _BENIGN or verdict is None:
+            continue
+        frac = (s.get("fractions") or {}).get(verdict, 0.0)
+        sec = (s.get("seconds") or 0.0) * frac
+        if sec <= 0:
+            continue
+        sugg = _suggestions_for(verdict, summary, entry, run_settings)
+        findings.append({
+            "stage": sid,
+            "bottleneck": verdict,
+            "impact_seconds": round(sec, 4),
+            "severity": _severity(sec, wall),
+            "evidence": "stage {} ({}, {:.2f}s): {} covers {:.0%} of "
+                        "stage wall".format(
+                            sid, entry["kind"], s.get("seconds") or 0.0,
+                            verdict, frac),
+            "suggestions": sugg,
+        })
+
+    # -- run-level signals the per-stage windows can miss --------------------
+    # Only where no per-stage finding already names the same verdict: a
+    # stage-level spill-queue finding and its run-level mirror are ONE
+    # root cause — double-reporting would rank the same seconds twice
+    # and demote genuinely distinct second-place bottlenecks.
+    staged_verdicts = {f["bottleneck"] for f in findings}
+    io = summary.get("io") or {}
+    if ("spill-queue" not in staged_verdicts
+            and (io.get("io_wait_write_fraction") or 0.0) > 0.05):
+        # io_wait_write_seconds is THREAD-seconds (concurrently blocked
+        # folds each add their own wait); impact must be on the same
+        # wall-clock axis the stage findings rank on, so clamp the
+        # fraction at 1 and charge wall time.
+        frac = min(1.0, io["io_wait_write_fraction"])
+        sec = frac * wall
+        findings.append({
+            "stage": None,
+            "bottleneck": "spill-queue",
+            "impact_seconds": round(sec, 4),
+            "severity": _severity(sec, wall),
+            "evidence": "folds spent {:.2f} thread-seconds blocked on "
+                        "writer-pool backpressure ({:.0%} of wall, "
+                        "clamped)".format(
+                            io.get("io_wait_write_seconds") or sec, frac),
+            "suggestions": _suggestions_for("spill-queue", summary,
+                                            run_settings=run_settings),
+        })
+    ov = summary.get("overlap") or {}
+    if ("overlap-stall" not in staged_verdicts
+            and (ov.get("stall_fraction") or 0.0) > 0.15):
+        sec = min(1.0, ov["stall_fraction"]) * wall
+        findings.append({
+            "stage": None,
+            "bottleneck": "overlap-stall",
+            "impact_seconds": round(sec, 4),
+            "severity": _severity(sec, wall),
+            "evidence": "codec_wait union covered {:.0%} of wall — every "
+                        "live fold consumer was starved by its codec "
+                        "producer".format(ov["stall_fraction"]),
+            "suggestions": _suggestions_for("overlap-stall", summary,
+                                            run_settings=run_settings),
+        })
+    met = (summary.get("metrics") or {}).get("sampler") or {}
+    if (met.get("overhead") or 0.0) > 0.03:
+        sec = min(1.0, met["overhead"]) * wall
+        interval = (run_settings.get("metrics_interval_ms")
+                    or met.get("interval_ms") or 100)
+        findings.append({
+            "stage": None,
+            "bottleneck": "host-compute",
+            "impact_seconds": round(sec, 4),
+            "severity": "low",
+            "evidence": "metrics sampler overhead {:.2%} exceeds the 3% "
+                        "budget".format(met["overhead"]),
+            "suggestions": [{
+                "setting": "metrics_interval_ms",
+                "env": "DAMPR_TPU_METRICS_MS",
+                "current": interval,
+                "suggested": max(200, interval * 4),
+                "why": "a longer sampling cadence bounds sampler cost",
+            }],
+        })
+
+    findings.sort(key=lambda f: -(f.get("impact_seconds") or 0.0))
+    for rank, f in enumerate(findings, 1):
+        f["rank"] = rank
+
+    report = {
+        "schema": SCHEMA,
+        "run": summary.get("run"),
+        "wall_seconds": wall,
+        "bottleneck": ((section or {}).get("run") or {}).get("verdict"),
+        "critpath_source": (section or {}).get("source"),
+        "stages": stage_entries,
+        "findings": findings,
+        "history_entries": len(hist),
+        "crashed": flightrec.locate_crashdump(run) is not None,
+    }
+    return report
+
+
+def _by_sid(summary):
+    return {st.get("stage"): st for st in summary.get("stages") or ()}
+
+
+def diff(run_a, run_b):
+    """Comparison report for two runs: wall and per-stage deltas,
+    verdict changes, and settings-snapshot differences (from each run's
+    newest history-corpus record when available)."""
+    from . import critpath, history
+
+    sec_a, sum_a = critpath.from_run(run_a)
+    sec_b, sum_b = critpath.from_run(run_b)
+    if sum_a is None or sum_b is None:
+        missing = run_a if sum_a is None else run_b
+        raise DoctorError("no stats.json found for {!r}".format(missing))
+    wall_a = sum_a.get("wall_seconds") or 0.0
+    wall_b = sum_b.get("wall_seconds") or 0.0
+    verd_a = {s.get("stage"): s.get("verdict")
+              for s in (sec_a or {}).get("stages") or ()}
+    verd_b = {s.get("stage"): s.get("verdict")
+              for s in (sec_b or {}).get("stages") or ()}
+    stages = []
+    a_stages, b_stages = _by_sid(sum_a), _by_sid(sum_b)
+    for sid in sorted(set(a_stages) | set(b_stages)):
+        sa, sb = a_stages.get(sid) or {}, b_stages.get(sid) or {}
+        stages.append({
+            "stage": sid,
+            "kind": sb.get("kind") or sa.get("kind"),
+            "seconds_a": sa.get("seconds"),
+            "seconds_b": sb.get("seconds"),
+            "delta_seconds": (round(sb["seconds"] - sa["seconds"], 4)
+                              if isinstance(sa.get("seconds"), (int, float))
+                              and isinstance(sb.get("seconds"),
+                                             (int, float)) else None),
+            "verdict_a": verd_a.get(sid),
+            "verdict_b": verd_b.get(sid),
+        })
+
+    def newest_settings(run_name):
+        recs = history.load(run_name)
+        return (recs[-1].get("settings") or {}) if recs else {}
+
+    set_a = newest_settings(sum_a.get("run"))
+    set_b = newest_settings(sum_b.get("run"))
+    settings_delta = {
+        k: {"a": set_a.get(k), "b": set_b.get(k)}
+        for k in sorted(set(set_a) | set(set_b))
+        if set_a.get(k) != set_b.get(k)
+    }
+    return {
+        "schema": SCHEMA,
+        "run": "{} vs {}".format(sum_a.get("run"), sum_b.get("run")),
+        "wall_seconds": wall_b,
+        "bottleneck": ((sec_b or {}).get("run") or {}).get("verdict"),
+        "critpath_source": (sec_b or {}).get("source"),
+        "stages": [],
+        "findings": [],
+        "history_entries": 0,
+        "crashed": False,
+        "diff": {
+            "run_a": sum_a.get("run"), "run_b": sum_b.get("run"),
+            "wall_a": wall_a, "wall_b": wall_b,
+            "wall_delta": round(wall_b - wall_a, 4),
+            "wall_ratio": (round(wall_b / wall_a, 4) if wall_a > 0
+                           else None),
+            "stages": stages,
+            "settings_delta": settings_delta,
+        },
+    }
+
+
+def format_report(report):
+    """Human-readable rendering."""
+    lines = []
+    add = lines.append
+    d = report.get("diff")
+    if d:
+        add("doctor diff: {} -> {}".format(d["run_a"], d["run_b"]))
+        ratio = d.get("wall_ratio")
+        add("wall: {:.2f}s -> {:.2f}s ({})".format(
+            d["wall_a"], d["wall_b"],
+            "{:+.1%}".format(ratio - 1) if ratio else "n/a"))
+        for st in d["stages"]:
+            line = "  stage {:>2} ({:<10}) {:>8} -> {:>8}".format(
+                st["stage"], st.get("kind") or "?",
+                "{:.2f}s".format(st["seconds_a"])
+                if st.get("seconds_a") is not None else "-",
+                "{:.2f}s".format(st["seconds_b"])
+                if st.get("seconds_b") is not None else "-")
+            if st.get("verdict_a") or st.get("verdict_b"):
+                line += "   {} -> {}".format(st.get("verdict_a") or "?",
+                                             st.get("verdict_b") or "?")
+            add(line)
+        if d["settings_delta"]:
+            add("settings changed:")
+            for k, v in sorted(d["settings_delta"].items()):
+                add("  {}: {!r} -> {!r}".format(k, v["a"], v["b"]))
+        else:
+            add("settings: no recorded differences")
+        return "\n".join(lines)
+
+    add("run {}: {:.2f}s wall · bottleneck: {}".format(
+        report.get("run"), report.get("wall_seconds") or 0.0,
+        report.get("bottleneck") or "?"))
+    if report.get("crashed"):
+        add("NOTE: this run left a crashdump (it did not finish cleanly)")
+    if report.get("critpath_source") == "summary":
+        add("note: no span timeline — verdicts are stats-derived "
+            "(trace the run for per-stage windows: DAMPR_TPU_TRACE=1)")
+    for st in report.get("stages") or ():
+        fr = st.get("fractions") or {}
+        top = ", ".join("{} {:.0%}".format(k, v) for k, v in sorted(
+            fr.items(), key=lambda kv: -kv[1])[:3])
+        add("  stage {:>2} ({:<10} {:>6}) {:>8}  {}  [{}]".format(
+            st.get("stage"), st.get("kind") or "?",
+            st.get("target") or "host",
+            "{:.2f}s".format(st["seconds"])
+            if st.get("seconds") is not None else "-",
+            st.get("verdict") or "?", top))
+    if not report.get("findings"):
+        add("no findings: nothing instrumented dominates — this run "
+            "looks healthy at the recorded granularity")
+    for f in report.get("findings") or ():
+        add("{}. [{}] {}".format(f["rank"], f["severity"], f["evidence"]))
+        for s in f.get("suggestions") or ():
+            env = " ({})".format(s["env"]) if s.get("env") else ""
+            tail = ("{!r} -> {!r}".format(s["current"], s["suggested"])
+                    if s.get("suggested") is not None
+                    else "current {!r}".format(s["current"]))
+            add("   -> settings.{}{}: {}".format(s["setting"], env, tail))
+            add("      {}".format(s["why"]))
+    if report.get("history_entries"):
+        add("history: {} recorded run(s) under this name "
+            "(dampr-tpu-doctor --diff compares two)".format(
+                report["history_entries"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    """Console entry (``dampr-tpu-doctor``)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="diagnose a dampr_tpu run: ranked bottlenecks with "
+                    "concrete settings suggestions")
+    ap.add_argument("run", help="run name, run scratch/trace directory, "
+                                "or stats.json path")
+    ap.add_argument("runs", nargs="*",
+                    help="(with --diff) the second run")
+    ap.add_argument("--diff", action="store_true",
+                    help="compare two runs: doctor --diff RUN_A RUN_B")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report "
+                         "(docs/doctor_schema.json)")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.diff:
+            if len(args.runs) != 1:
+                ap.error("--diff takes exactly two runs")
+            report = diff(args.run, args.runs[0])
+        else:
+            if args.runs:
+                ap.error("one run expected (use --diff to compare two)")
+            report = diagnose(args.run)
+    except DoctorError as e:
+        print("doctor: {}".format(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    # A crashed run is a diagnosis, not a doctor failure — but scripts
+    # should see it (same convention as dampr-tpu-stats).
+    return 3 if report.get("crashed") else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

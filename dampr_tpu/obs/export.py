@@ -81,9 +81,17 @@ def counter_events(metrics, pid=1):
         for t, v in series[name]:
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 continue
+            # Epoch alignment clamp: the runner points the registry at
+            # the tracer's (earlier) epoch, but a registry whose first
+            # sample landed before that re-point — or an independently
+            # constructed Metrics whose epoch postdates a recorded tick
+            # — would yield a NEGATIVE relative timestamp here, which
+            # Chrome/Perfetto renders as a broken counter track and the
+            # schema validator rejects.  Clamp to the run origin; the
+            # sample still carries its value, just pinned to t=0.
             out.append({"ph": "C", "name": name, "cat": "metric",
                         "pid": pid, "tid": 0,
-                        "ts": round(t * 1e6, 3),
+                        "ts": max(0.0, round(t * 1e6, 3)),
                         "args": {"value": v}})
     return out
 

@@ -1,0 +1,264 @@
+"""Run-history corpus: an accumulated per-operator telemetry record.
+
+``plan/cost.py`` used to adapt from exactly ONE prior ``stats.json`` —
+a single noisy sample, and only when the previous run happened to be
+traced.  Following the tf.data-service argument (PAPERS.md, arXiv
+2210.14826: auto-tuning needs an accumulated telemetry corpus, not the
+last data point), every finalized run now appends one compact summary
+record to a bounded JSONL index under its scratch root::
+
+    <scratch_root>/<run>/history.jsonl    # settings.history_entries cap
+
+Each line is one self-contained JSON record (schema
+``dampr-tpu-history/1``): the plan fingerprint + stage shapes (the
+match key), per-stage IO measurements, critical-path verdicts
+(:mod:`.critpath`), the per-op profile when :mod:`.profile` was on,
+run throughput, and a snapshot of the performance-shaping settings.
+
+Durability contract:
+
+- **crash-safe append**: one ``O_APPEND`` write of one line; a run that
+  dies mid-write corrupts at most its own line;
+- **line-validated read**: unparsable or wrong-schema lines are skipped,
+  never fatal — a corrupt corpus degrades to fewer samples;
+- **bounded**: past ``settings.history_entries`` the file is compacted
+  to the newest entries via tmp + atomic rename.
+
+Consumers: :func:`dampr_tpu.plan.cost.matched_history` (median over >= 3
+shape-matching runs, recency-bounded by ``settings.history_window``),
+``dampr-tpu-doctor`` (``--diff`` and trend context), and the ROADMAP
+item-5 learned cost model this corpus is the feedstock for.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import statistics
+import threading
+
+from .. import settings
+
+log = logging.getLogger("dampr_tpu.obs.history")
+
+SCHEMA = "dampr-tpu-history/1"
+FILE = "history.jsonl"
+
+_append_lock = threading.Lock()
+
+#: Settings whose values shape run performance: snapshotted per record so
+#: ``doctor --diff`` can attribute a regression to a config change.
+_KNOBS = ("partitions", "batch_size", "max_memory_per_stage",
+          "overlap_windows", "spill_write_threads", "spill_read_prefetch",
+          "merge_fanin", "max_processes", "optimize", "profile")
+
+
+def corpus_path(run_name):
+    """Where a run name's corpus lives (next to its durable scratch
+    outputs — NOT under trace_dir, which may point at throwaway test
+    directories)."""
+    safe = str(run_name).replace("/", "_")
+    return os.path.join(settings.scratch_root, safe, FILE)
+
+
+def plan_fingerprint(stage_shapes):
+    """Stable fingerprint of a plan's executed stage-shape sequence (the
+    corpus match key, also reusable by the service layer's plan dedupe)."""
+    text = "|".join(s.get("shape", "?") for s in stage_shapes or ())
+    return hashlib.sha1(text.encode("utf-8")).hexdigest()[:16]
+
+
+def _settings_snapshot():
+    snap = {k: getattr(settings, k, None) for k in _KNOBS}
+    snap["lower"] = str(settings.lower)
+    snap["metrics_interval_ms"] = settings.metrics_interval_ms
+    snap["spill_codec"] = str(settings.spill_codec)
+    return snap
+
+
+def compact_record(summary):
+    """One corpus line from a finalized run summary (the stats.json
+    dict).  Compact by construction: per-stage scalars, verdict strings,
+    and the top per-op timings only — never spans or series."""
+    stages = []
+    for st in summary.get("stages") or ():
+        stages.append({k: st.get(k) for k in (
+            "stage", "kind", "target", "jobs", "records_in", "records_out",
+            "bytes_in", "bytes_out", "spill_bytes", "seconds")})
+    rec = {
+        "schema": SCHEMA,
+        "run": summary.get("run"),
+        "ts": summary.get("started_at"),
+        "wall_seconds": summary.get("wall_seconds"),
+        "n_partitions": summary.get("n_partitions"),
+        "stage_shapes": (summary.get("plan") or {}).get("stage_shapes") or [],
+        "stages": stages,
+        "throughput": {
+            "records_out": (summary.get("totals") or {}).get("records_out"),
+            "bytes_out": (summary.get("totals") or {}).get("bytes_out"),
+            "mbps": (round((summary.get("totals") or {}).get("bytes_out", 0)
+                           / 1e6 / summary["wall_seconds"], 3)
+                     if summary.get("wall_seconds") else None),
+        },
+        "device_fraction": (summary.get("device") or {}).get(
+            "device_fraction"),
+        "io_wait_fraction": (summary.get("io") or {}).get(
+            "io_wait_fraction"),
+        "settings": _settings_snapshot(),
+    }
+    rec["fingerprint"] = plan_fingerprint(rec["stage_shapes"])
+    crit = summary.get("critpath")
+    if crit:
+        rec["critpath"] = {
+            "run": (crit.get("run") or {}).get("verdict"),
+            "stages": {str(s.get("stage")): s.get("verdict")
+                       for s in crit.get("stages") or ()},
+        }
+    prof = summary.get("profile")
+    if prof:
+        rec["profile"] = {
+            str(s["stage"]): [[o["op"], o["seconds"], o["records"]]
+                              for o in (s.get("ops") or [])[:5]]
+            for s in prof.get("stages") or ()
+        }
+    return rec
+
+
+def append(summary):
+    """Append one finalized run's record; best-effort (corpus failures
+    must never fail a run) and bounded.  Returns the corpus path or
+    None."""
+    if settings.history_entries <= 0:
+        return None
+    run = summary.get("run")
+    if not run or not summary.get("stages"):
+        return None
+    try:
+        rec = compact_record(summary)
+        line = json.dumps(rec, sort_keys=True,
+                          separators=(",", ":"), default=str)
+        if "\n" in line:  # a pathological repr leaked a newline: refuse
+            return None   # to corrupt the line-oriented index
+        path = corpus_path(run)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with _append_lock:
+            fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                         0o644)
+            try:
+                os.write(fd, (line + "\n").encode("utf-8"))
+            finally:
+                os.close(fd)
+            _compact_if_over(path)
+        return path
+    except Exception:
+        log.debug("history corpus append failed for %r", run,
+                  exc_info=True)
+        return None
+
+
+def _compact_if_over(path):
+    """Rewrite the corpus keeping only the newest ``history_entries``
+    valid lines (tmp + atomic replace; called under the append lock)."""
+    cap = settings.history_entries
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            lines = f.readlines()
+    except OSError:
+        return
+    if len(lines) <= cap:
+        return
+    keep = [ln for ln in lines if _valid_line(ln) is not None][-cap:]
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.writelines(keep)
+    os.replace(tmp, path)
+
+
+def _valid_line(line):
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None
+    if not isinstance(rec, dict) or rec.get("schema") != SCHEMA:
+        return None
+    if not isinstance(rec.get("stages"), list):
+        return None
+    return rec
+
+
+def load(run_name):
+    """Every valid record for a run name, oldest -> newest.  Never
+    raises; a missing or corrupt corpus is just an empty history."""
+    path = corpus_path(run_name) if run_name else None
+    if not path or not os.path.isfile(path):
+        return []
+    out = []
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                rec = _valid_line(line)
+                if rec is not None:
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def matching(records, stage_shapes):
+    """Records whose stage-shape sequence equals ``stage_shapes`` —
+    per-sid measurements are meaningless across plan shapes."""
+    want = [s.get("shape") for s in stage_shapes or ()]
+    return [r for r in records
+            if [s.get("shape") for s in r.get("stage_shapes") or ()] == want]
+
+
+def _median(values):
+    vals = [v for v in values if isinstance(v, (int, float))
+            and not isinstance(v, bool)]
+    if not vals:
+        return None
+    m = statistics.median(vals)
+    return int(m) if all(isinstance(v, int) for v in vals) else m
+
+
+def synthesize(records):
+    """Fold shape-matching corpus records into ONE stats-summary-shaped
+    history dict the existing adaptation code consumes unchanged.
+
+    - one or two records: the newest record verbatim (byte-equivalent to
+      the old single-stats.json behavior — the equivalence pin);
+    - three or more: per-stage **medians** of the IO measurements, so a
+      single outlier run (cold cache, noisy neighbor) stops steering the
+      sizing.
+    """
+    if not records:
+        return None
+    newest = records[-1]
+    n = len(records)
+    if n < 3:
+        stages = [dict(st) for st in newest.get("stages") or ()]
+    else:
+        by_sid = {}
+        for rec in records:
+            for st in rec.get("stages") or ():
+                by_sid.setdefault(st.get("stage"), []).append(st)
+        stages = []
+        for sid, sts in sorted(by_sid.items()):
+            med = dict(sts[-1])  # kind/target/stage from the newest
+            for field in ("jobs", "records_in", "records_out", "bytes_in",
+                          "bytes_out", "spill_bytes", "seconds"):
+                v = _median([st.get(field) for st in sts])
+                if v is not None:
+                    med[field] = v
+            stages.append(med)
+    return {
+        "run": newest.get("run"),
+        "stages": stages,
+        "plan": {"stage_shapes": newest.get("stage_shapes") or []},
+        "stats_file": "history:{}#n={}".format(
+            corpus_path(newest.get("run")), n),
+        "history_entries": n,
+    }

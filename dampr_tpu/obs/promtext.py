@@ -31,11 +31,21 @@ def sanitize(name):
     return out
 
 
+def escape_label_value(value):
+    """Escape a label VALUE per the Prometheus text-format spec
+    (exposition formats, version 0.0.4): backslash first (so escapes
+    don't double-escape), then double-quote, then line feed as the two
+    characters ``\\n`` — a raw newline inside a label would truncate the
+    sample line and corrupt the whole exposition."""
+    return (str(value).replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _labels(run):
     if not run:
         return ""
-    return '{{run="{}"}}'.format(str(run).replace("\\", "\\\\")
-                                 .replace('"', '\\"'))
+    return '{{run="{}"}}'.format(escape_label_value(run))
 
 
 def _num(v):
@@ -66,8 +76,11 @@ def render(metrics):
 
 def render_summary(stats_summary):
     """A persisted stats.json dict (or a fragment with a ``metrics``
-    key) -> exposition text.  Returns "" when the run carried no
-    metrics section (pre-metrics stats files stay renderable)."""
+    key) -> exposition text.  A run with no metrics section (or an
+    empty registry) renders as the EMPTY exposition — zero bytes is the
+    valid text-format encoding of "no samples", and scrapers/promtool
+    accept it; callers that want to tell the user about it check
+    falsiness (the stats CLI does)."""
     m = stats_summary.get("metrics") or {}
     run = stats_summary.get("run")
     lines = []

@@ -156,6 +156,27 @@ def add(kind, seconds):
         _counters[kind] += seconds
 
 
+def union_seconds(intervals):
+    """Total length of the union of ``(t0, t1)`` intervals — the same
+    wall-clock-union discipline the live ``codec_wait`` bucket applies to
+    slot stalls, as a pure function over recorded spans.  Concurrent
+    lanes doing the same kind of work (two codec producers tokenizing at
+    once) count the covered WALL time once, never their thread-seconds
+    summed; this is what lets the critical-path analyzer
+    (:mod:`dampr_tpu.obs.critpath`) compare resources against elapsed
+    wall on an equal footing."""
+    total = 0.0
+    end = None
+    for t0, t1 in sorted(i for i in intervals if i[1] > i[0]):
+        if end is None or t0 > end:
+            total += t1 - t0
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total
+
+
 def snapshot():
     with _lock:
         out = dict(_counters)

@@ -38,10 +38,12 @@ batch boundaries are unobservable in the results.
 """
 
 import functools
+import time
 
 import numpy as np
 
 from .. import settings
+from ..obs import profile as _profile
 from ..obs import trace as _trace
 from . import devtime
 from .text import (_LOWER, _SHORT_TOKEN, _token_bounds, chunk_doc_freq,
@@ -328,8 +330,13 @@ class DeviceTokenFoldSink(object):
     # -- the pipeline ------------------------------------------------------
     def _dispatch(self, buf, starts, lens, lines):
         """Pad one batch to its shape bucket and launch the program; h2d
-        payload bytes are charged to the store's HBM counters."""
+        payload bytes are charged to the store's HBM counters.  Under
+        ``settings.profile`` the loop's sub-phases decompose: ``build``
+        (padded-matrix construction, host) and ``h2d`` (program dispatch
+        + argument feed) here, ``compute``/``d2h`` at drain."""
         n = len(starts)
+        prof = _profile.active()
+        t0p = time.perf_counter() if prof is not None else 0.0
         with devtime.track("codec"):
             L = _len_bucket(lens.max())
             npad = max(_pow2(n),
@@ -344,28 +351,50 @@ class DeviceTokenFoldSink(object):
             lines_p = np.zeros(npad, dtype=np.int32)
             if lines is not None:
                 lines_p[:n] = lines
+        if prof is not None:
+            prof.device_add("build", time.perf_counter() - t0p,
+                            mat.nbytes)
         fn = _token_fold_jit(npad, L, self.dedup,
                              settings.lower_pallas_segfold,
                              _lower_interpret())
         nbytes = mat.nbytes + lens_p.nbytes + lines_p.nbytes
         if self.store is not None:
             self.store.count_h2d(nbytes)
+        t0p = time.perf_counter() if prof is not None else 0.0
         with devtime.track("device"), _trace.span(
                 "device", "map-fold", tokens=n, bytes=nbytes):
             out = fn(mat, lens_p, lines_p)
+        if prof is not None:
+            # Dispatch is async: this phase is the launch + feed cost;
+            # the program's run time surfaces as ``compute`` at drain.
+            prof.device_add("h2d", time.perf_counter() - t0p, nbytes)
         self.batches += 1
         return _Batch(out, starts, lens, n)
 
     def _drain(self, buf, batch):
         """Fetch one program's results and build the partial-count Block
         (vocabulary-sized).  Collisions re-group the batch on host."""
+        prof = _profile.active()
         with devtime.track("device"), _trace.span("device", "drain",
                                                   tokens=batch.n):
+            if prof is not None:
+                # Split blocked-on-program time from the result fetch:
+                # block_until_ready waits for the compute, the asarray
+                # conversions below are then pure d2h movement.
+                import jax
+
+                t0p = time.perf_counter()
+                jax.block_until_ready(batch.out)
+                t1p = time.perf_counter()
+                prof.device_add("compute", t1p - t0p)
             sh1, sh2, tot, live, rep_orig, collisions = (
                 np.asarray(a) for a in batch.out)
+        d2h_bytes = (sh1.nbytes + sh2.nbytes + tot.nbytes
+                     + live.nbytes + rep_orig.nbytes)
+        if prof is not None:
+            prof.device_add("d2h", time.perf_counter() - t1p, d2h_bytes)
         if self.store is not None:
-            self.store.count_d2h(sh1.nbytes + sh2.nbytes + tot.nbytes
-                                 + live.nbytes + rep_orig.nbytes)
+            self.store.count_d2h(d2h_bytes)
         if int(collisions):
             lines = None
             if self.dedup:

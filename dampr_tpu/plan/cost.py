@@ -1,9 +1,13 @@
 """Stats-driven adaptive execution.
 
-A prior run under the same name leaves a ``stats.json`` summary (written
-by the obs layer for traced runs) carrying per-stage records/bytes in and
-out plus the plan's stage shapes.  When the CURRENT optimized plan has the
-same shape sequence, those measurements size this run:
+Every finalized run under a name appends one record to the run-history
+corpus (:mod:`dampr_tpu.obs.history`) carrying per-stage records/bytes
+in and out plus the plan's stage shapes; traced runs additionally leave
+a ``stats.json`` (the pre-corpus source, still honored as a fallback).
+When the CURRENT optimized plan has the same shape sequence, those
+measurements — the newest record when the corpus holds fewer than three
+matching runs, per-stage medians over the recent window otherwise — size
+this run:
 
 - **partition count**: the run's ``n_partitions`` is re-derived from the
   largest observed reduce input (``plan_partition_bytes`` per partition,
@@ -48,18 +52,50 @@ def load_history(run_name):
         return None
 
 
-def matched_history(run_name, graph):
-    """The prior run's summary, but only when its plan stage shapes match
-    ``graph`` — per-sid measurements are meaningless across shapes.  Used
-    by the lowering pass's stats-driven placement and by explain()."""
+def corpus_history(run_name, graph):
+    """(history, reason) for this run name from the run-history corpus
+    (:mod:`dampr_tpu.obs.history`).
+
+    The corpus accumulates one record per finalized run; only records
+    whose stage-shape sequence matches ``graph`` count (per-sid
+    measurements are meaningless across shapes).  One or two matching
+    records behave exactly like the old single-stats.json path (the
+    newest record verbatim — equivalence-pinned); three or more feed
+    per-stage MEDIANS over the ``settings.history_window`` most recent,
+    so one outlier run stops steering the sizing.  Runs that predate the
+    corpus fall back to their stats.json.  Returns ``(None, reason)``
+    when nothing usable exists; never raises."""
+    if not run_name:
+        return None, "no-history"
+    shapes_now = ir.stage_shapes(graph)
+    try:
+        from ..obs import history
+
+        records = history.load(run_name)
+        if records:
+            matched = history.matching(records, shapes_now)
+            if not matched:
+                return None, "shape-mismatch"
+            window = max(1, settings.history_window)
+            return history.synthesize(matched[-window:]), None
+    except Exception:
+        log.debug("history corpus unreadable for %r", run_name,
+                  exc_info=True)
     hist = load_history(run_name)
     if hist is None:
-        return None
+        return None, "no-history"
     shapes_prev = (hist.get("plan") or {}).get("stage_shapes") or []
-    shapes_now = ir.stage_shapes(graph)
     if ([s.get("shape") for s in shapes_prev]
             != [s["shape"] for s in shapes_now]):
-        return None
+        return None, "shape-mismatch"
+    return hist, None
+
+
+def matched_history(run_name, graph):
+    """The shape-matched history for ``run_name`` (corpus-backed), or
+    None.  Used by the lowering pass's stats-driven placement and by
+    explain()."""
+    hist, _reason = corpus_history(run_name, graph)
     return hist
 
 
@@ -98,17 +134,12 @@ def adapt(runner, graph, report):
         # hash per-stage options: re-sizing would orphan every checkpoint.
         info["reason"] = "resumable-run"
         return
-    hist = load_history(getattr(runner, "name", None))
+    hist, reason = corpus_history(getattr(runner, "name", None), graph)
     if hist is None:
-        info["reason"] = "no-history"
-        return
-    shapes_prev = (hist.get("plan") or {}).get("stage_shapes") or []
-    shapes_now = ir.stage_shapes(graph)
-    if ([s.get("shape") for s in shapes_prev]
-            != [s["shape"] for s in shapes_now]):
-        info["reason"] = "shape-mismatch"
+        info["reason"] = reason
         return
     info["history"] = hist.get("stats_file") or hist.get("run")
+    info["history_entries"] = hist.get("history_entries", 1)
     by_sid = {s.get("stage"): s for s in hist.get("stages", [])}
 
     # -- run-level partition count ------------------------------------------

@@ -51,32 +51,33 @@ def explain_text(graph, outputs, name=None):
             f["rule"], "  +  ".join(f["members"]), f["into"]))
     for d in report["dead"]:
         lines.append("  dead: {}".format(d))
-    # Adaptive annotations (best-effort; needs a prior traced run).
+    # Adaptive annotations (best-effort; needs prior finalized runs —
+    # the history corpus, or a traced run's stats.json as fallback).
     if not settings.plan_adapt:
         lines.append("adaptive: off (settings.plan_adapt)")
     else:
-        hist = cost.load_history(name) if name else None
+        hist, reason = (cost.corpus_history(name, optimized)
+                        if name else (None, "no-history"))
         if hist is None:
-            lines.append("adaptive: no history{} — static defaults "
+            what = ("history shape mismatch"
+                    if reason == "shape-mismatch" else "no history{}".format(
+                        " for run {!r}".format(name) if name else ""))
+            lines.append("adaptive: {} — static defaults "
                          "(partitions={}, batch_size={})".format(
-                             " for run {!r}".format(name) if name else "",
-                             settings.partitions, settings.batch_size))
+                             what, settings.partitions,
+                             settings.batch_size))
         else:
-            shapes_prev = (hist.get("plan") or {}).get("stage_shapes") or []
-            shapes_now = ir.stage_shapes(optimized)
-            if ([s.get("shape") for s in shapes_prev]
-                    != [s["shape"] for s in shapes_now]):
-                lines.append("adaptive: history shape mismatch — static "
-                             "defaults")
-            else:
-                lines.append("adaptive: history {} ({} stages measured)"
-                             .format(hist.get("stats_file") or name,
-                                     len(hist.get("stages", []))))
-                for st in hist.get("stages", []):
-                    lines.append(
-                        "    s{}: {}  {} rec / {} B out".format(
-                            st.get("stage"), st.get("kind"),
-                            st.get("records_out"), st.get("bytes_out")))
+            n = hist.get("history_entries", 1)
+            lines.append("adaptive: history {} ({} stages measured{})"
+                         .format(hist.get("stats_file") or name,
+                                 len(hist.get("stages", [])),
+                                 ", median over {} runs".format(n)
+                                 if n >= 3 else ""))
+            for st in hist.get("stages", []):
+                lines.append(
+                    "    s{}: {}  {} rec / {} B out".format(
+                        st.get("stage"), st.get("kind"),
+                        st.get("records_out"), st.get("bytes_out")))
     lines.extend(_target_lines(optimized, name, outputs))
     return "\n".join(lines)
 
@@ -90,7 +91,9 @@ def _target_lines(graph, name, outputs=()):
                      "every stage executes on host)".format(settings.lower))
         return lines
     decisions = lower.analyze(
-        graph, cost.matched_history(name, graph) if name else None,
+        graph,
+        (cost.matched_history(name, graph)
+         if name and not settings.lower_forced() else None),
         outputs)
     n_dev = sum(1 for d in decisions if d["target"] == "device")
     lines.append("targets: {} of {} executed stages lowered to device "

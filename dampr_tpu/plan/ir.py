@@ -226,19 +226,31 @@ def graph_signature(graph):
     return tuple(sig)
 
 
+def stage_provenance(stage):
+    """The original-stage descriptions a fused node was built from (ridden
+    onto fused nodes by :mod:`.passes` for the per-operator profiler), or
+    None for never-fused stages."""
+    return getattr(stage, "_provenance", None)
+
+
 def clone_with_options(stage, options):
     """Fresh node with replaced options — shared StageNodes are never
     mutated (graphs are copy-on-write; a node may live in other handles'
-    graphs)."""
+    graphs).  Fusion provenance survives the clone."""
     if isinstance(stage, GMap):
-        return GMap(stage.inputs, stage.output, stage.mapper,
-                    stage.combiner, stage.shuffler, options)
-    if isinstance(stage, GReduce):
-        return GReduce(stage.inputs, stage.output, stage.reducer, options)
-    if isinstance(stage, GSink):
-        return GSink(stage.inputs, stage.output, stage.sinker, stage.path,
-                     options)
-    raise TypeError("cannot clone {!r}".format(stage))
+        out = GMap(stage.inputs, stage.output, stage.mapper,
+                   stage.combiner, stage.shuffler, options)
+    elif isinstance(stage, GReduce):
+        out = GReduce(stage.inputs, stage.output, stage.reducer, options)
+    elif isinstance(stage, GSink):
+        out = GSink(stage.inputs, stage.output, stage.sinker, stage.path,
+                    options)
+    else:
+        raise TypeError("cannot clone {!r}".format(stage))
+    prov = stage_provenance(stage)
+    if prov is not None:
+        out._provenance = prov
+    return out
 
 
 def rebuilt(stages):

@@ -197,7 +197,15 @@ def apply(runner, outputs, report):
         return
     from . import cost
 
-    history = cost.matched_history(getattr(runner, "name", None), graph)
+    # Stats-driven placement is an AUTO-mode behavior: when the master
+    # switch is explicitly forced ("1"/"on"), the operator asked for
+    # device execution and the run-history floor (lower_min_records)
+    # must not silently pin eligible stages back to host — forced legs
+    # (CI's lower-on matrix, a user's DAMPR_TPU_LOWER=1) stay
+    # deterministic regardless of accumulated corpus state.
+    history = (None if settings.lower_forced()
+               else cost.matched_history(getattr(runner, "name", None),
+                                         graph))
     decisions = analyze(graph, history, outputs)
     section = report["lowering"]
     section["enabled"] = True

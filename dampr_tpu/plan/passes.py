@@ -145,6 +145,14 @@ def _fuse_maps(stages, protected, report):
                 "into": ir.describe_stage(fused),
                 "members": [ir.describe_stage(a), ir.describe_stage(b)],
             })
+            # Provenance rides the fused node (an attribute, not options —
+            # options feed resume fingerprints): the ordered descriptions
+            # of the ORIGINAL user stages this node absorbed, so the
+            # per-operator profiler (obs.profile) can attribute the fused
+            # stage's time back to the ops the user actually wrote.
+            fused._provenance = (
+                (ir.stage_provenance(a) or [ir.describe_stage(a)])
+                + (ir.stage_provenance(b) or [ir.describe_stage(b)]))
             # The fused node takes the producer's slot (its inputs'
             # producers all precede it); the tail's slot disappears.
             stages[ai] = fused

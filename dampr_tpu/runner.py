@@ -40,6 +40,7 @@ from .blocks import Block, BlockBuilder
 from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
 from .graph import GInput, GMap, GReduce, GSink
 from .obs import metrics as _metrics
+from .obs import profile as _profile
 from .obs import trace as _trace
 from .ops import segment
 
@@ -759,6 +760,12 @@ class MTRunner(object):
         self._sampler = None
         self._progress = None
         self._status = {}
+        # Per-operator profiler (settings.profile): attributes fused-stage
+        # time to individual user ops; summary ships as stats()["profile"].
+        self.profiler = None
+        # Failed runs must not feed the run-history corpus (their
+        # measurements would poison the adaptation medians).
+        self._run_failed = False
 
     # -- job fan-out --------------------------------------------------------
     def _pool_run(self, fn, jobs, n_workers, label=None):
@@ -792,6 +799,20 @@ class MTRunner(object):
                 #                          worker thread = one lane per slot
                 with _trace.span("job", label):
                     return _inner(job)
+
+        prof = _profile.active()
+        if prof is not None:
+            # Per-stage job thread-seconds: the denominator of the
+            # profiler's coverage metric (how much of the stage's job
+            # time the per-op attribution explains).
+            profiled = fn
+
+            def fn(job, _inner=profiled):  # noqa: F811
+                t0 = time.perf_counter()
+                try:
+                    return _inner(job)
+                finally:
+                    prof.job_add(time.perf_counter() - t0)
 
         m = _metrics.active()
         if m is not None:
@@ -1071,8 +1092,19 @@ class MTRunner(object):
                         for blk in wsink.finish() or ():
                             yield mi, blk
 
+                gen = codec()
+                prof = _profile.active()
+                if prof is not None:
+                    # The shared window pass serves EVERY member; its scan
+                    # time is attributed once, under a label naming the
+                    # fused scanners (per-member split is not observable —
+                    # one producer thread drives all the sinks).
+                    gen = prof.timed_iter(
+                        gen, "scan:" + "+".join(
+                            type(s.mapper).__name__ for s in stages),
+                        records_of=lambda it: len(it[1]))
                 for mi, blk in _overlap_stream(
-                        codec(), self.store,
+                        gen, self.store,
                         size_of=lambda it: it[1].nbytes()):
                     members[mi][1](blk)
                 return [end() for _wsink, _push, end in members]
@@ -1183,6 +1215,8 @@ class MTRunner(object):
                 if blk is None or not len(blk):
                     return
                 if combine_op is not None:
+                    prof = _profile.active()
+                    t0p = time.perf_counter() if prof is not None else 0.0
                     with _trace.span("fold", "partial-fold",
                                      records=len(blk)):
                         partials.append(segment.fold_block(blk, combine_op))
@@ -1191,15 +1225,22 @@ class MTRunner(object):
                                 Block.concat(partials), combine_op)
                             del partials[:]
                             partials.append(merged)
+                    if prof is not None:
+                        prof.op_add("combine", time.perf_counter() - t0p,
+                                    records=len(blk))
                 else:
                     raw.append(blk)
 
             def end():
                 blocks = raw
                 if combine_op is not None and partials:
+                    prof = _profile.active()
+                    t0p = time.perf_counter() if prof is not None else 0.0
                     with _trace.span("fold", "final-fold"):
                         blocks = [segment.fold_block(
                             Block.concat(partials), combine_op)]
+                    if prof is not None:
+                        prof.op_add("combine", time.perf_counter() - t0p)
                 if sorted_run_mode:
                     out = try_sorted_run(blocks)
                     if out is not None:
@@ -1272,6 +1313,13 @@ class MTRunner(object):
             chain = (base.record_op_chain(mapper)
                      if settings.batch_udf and not supplementary
                      and not use_blocks and not ident_blocks else None)
+            # Per-operator profiler (obs.profile): one hoisted None-check
+            # per job; labels are index-prefixed so duplicate op types in
+            # one fused chain stay distinct.
+            prof = _profile.active()
+            prof_labels = (_profile.chain_labels(chain)
+                           if prof is not None and chain is not None
+                           else None)
             push, end = new_sink()
             if (dev_lowered and not supplementary
                     and (hasattr(chunk, "read_bytes")
@@ -1291,8 +1339,13 @@ class MTRunner(object):
                 # scan + tokenize/parse inside map_blocks) runs ahead on
                 # its own thread while this thread folds/registers, with
                 # in-flight blocks charged against the run budget.
-                for blk in _overlap_stream(mapper.map_blocks(chunk),
-                                           self.store):
+                blocks_iter = mapper.map_blocks(chunk)
+                if prof is not None:
+                    # Each produced window's decompress+tokenize time is
+                    # the scanner op's attribution.
+                    blocks_iter = prof.timed_iter(
+                        blocks_iter, _profile.op_label(mapper, 0))
+                for blk in _overlap_stream(blocks_iter, self.store):
                     push(blk)
             elif ident_blocks:
                 for blk in chunk.iter_blocks():
@@ -1341,15 +1394,31 @@ class MTRunner(object):
                             at, step = 0, 1024
                             while at < n:
                                 took = min(step, n - at)
+                                t0p = (time.perf_counter()
+                                       if prof is not None else 0.0)
                                 sks, svs = op.apply_batch(
                                     ks[at:at + took], vs[at:at + took])
+                                if prof is not None:
+                                    prof.op_add(prof_labels[i],
+                                                time.perf_counter() - t0p,
+                                                records=len(sks))
                                 at += took
                                 if sks:
                                     fan = -(-len(sks) // took)
                                     step = max(64, min(B, B // fan))
                                     run_chain(sks, svs, i + 1)
                             return
-                        ks, vs = op.apply_batch(ks, vs)
+                        if prof is None:
+                            ks, vs = op.apply_batch(ks, vs)
+                        else:
+                            # One clock pair per op per BATCH — the
+                            # sampled-timer discipline that keeps the
+                            # profiled path inside the <=3% overhead gate.
+                            t0p = time.perf_counter()
+                            ks, vs = op.apply_batch(ks, vs)
+                            prof.op_add(prof_labels[i],
+                                        time.perf_counter() - t0p,
+                                        records=len(ks))
                         if not ks:
                             return
                     emit(ks, vs)
@@ -1361,9 +1430,22 @@ class MTRunner(object):
             else:
                 kvs = (mapper.map(chunk, *supplementary) if supplementary
                        else mapper.map(chunk))
-                for k, v in kvs:
-                    push(builder.add(k, v))
-                push(builder.flush())
+                if prof is not None and combine_op is None:
+                    # Generator-path chains don't decompose per op (the
+                    # fused generators interleave); attribute the whole
+                    # stream to one chain-shaped label so coverage holds.
+                    t0p = time.perf_counter()
+                    nrec = 0
+                    for k, v in kvs:
+                        nrec += 1
+                        push(builder.add(k, v))
+                    push(builder.flush())
+                    prof.op_add("stream:" + _profile.op_label(mapper),
+                                time.perf_counter() - t0p, records=nrec)
+                else:
+                    for k, v in kvs:
+                        push(builder.add(k, v))
+                    push(builder.flush())
             return end()
 
         return (job, combine_op, pin, feeds_reduce, new_sink,
@@ -2011,10 +2093,27 @@ class MTRunner(object):
 
             builder = BlockBuilder(settings.batch_size)
             refs = []
-            for k, v in record_stream:
-                blk = builder.add(k, v)
-                if blk is not None:
-                    refs.append(self.store.register(blk, pin=pin))
+            prof = _profile.active()
+            if prof is None:
+                # The profiler-off hot loop stays increment-free (the
+                # one-None-check-per-job contract).
+                for k, v in record_stream:
+                    blk = builder.add(k, v)
+                    if blk is not None:
+                        refs.append(self.store.register(blk, pin=pin))
+            else:
+                # Whole-stream attribution (a reducer doesn't decompose
+                # per op): grouping + the user's reduce + re-register.
+                t0p = time.perf_counter()
+                nrec_out = 0
+                for k, v in record_stream:
+                    nrec_out += 1
+                    blk = builder.add(k, v)
+                    if blk is not None:
+                        refs.append(self.store.register(blk, pin=pin))
+                prof.op_add(
+                    "reduce:" + _profile.op_label(stage.reducer),
+                    time.perf_counter() - t0p, records=nrec_out)
             blk = builder.flush()
             if blk is not None:
                 refs.append(self.store.register(blk, pin=pin))
@@ -2062,10 +2161,18 @@ class MTRunner(object):
             mapper = _clone_op(stage.sinker)
             part = os.path.join(stage.path, "part-{}".format(i))
             n = 0
+            prof = _profile.active()
+            t0p = time.perf_counter() if prof is not None else 0.0
             with open(part, "w", encoding="utf-8") as f:
                 for _k, v in mapper.map(chunk):
                     f.write("{}\n".format(v))
                     n += 1
+            if prof is not None:
+                # Sink chains don't decompose per op (fused generators
+                # interleave with the writes); one whole-stream label
+                # keeps the stage's coverage honest.
+                prof.op_add("sink:" + _profile.op_label(mapper),
+                            time.perf_counter() - t0p, records=n)
             return part, n
 
         n_maps = stage.options.get("n_maps", self.n_maps)
@@ -2180,6 +2287,11 @@ class MTRunner(object):
             self.tracer = _trace.Tracer(self.name)
             self.tracer.recorder = rec
             _trace.start(self.tracer)
+        if settings.profile:
+            # Per-operator attribution (obs.profile): passive — no
+            # thread; hot sites hoist the None-check to one per job.
+            self.profiler = _profile.Profiler(self.name)
+            _profile.start(self.profiler)
         if interval > 0:
             from .obs.metrics import Metrics
             from .obs.sampler import Sampler
@@ -2213,6 +2325,8 @@ class MTRunner(object):
             _metrics.stop(self.metrics)
         if self.tracer is not None:
             _trace.stop(self.tracer)
+        if self.profiler is not None:
+            _profile.stop(self.profiler)
         if self.flightrec is not None:
             _flightrec.stop(self.flightrec)
 
@@ -2240,6 +2354,7 @@ class MTRunner(object):
             # stage exception, KeyboardInterrupt, SIGTERM-raised exit —
             # leaves a bounded timeline tail with the last gauge samples
             # (writer-pool queue state included) instead of nothing.
+            self._run_failed = True
             if rec is not None:
                 if self._sampler is not None:
                     # One last snapshot so the dump's final samples show
@@ -2368,10 +2483,25 @@ class MTRunner(object):
             # sampler's self-accounting (samples, series drops, the
             # overhead self-metric) — the metrics plane measuring itself.
             summary["metrics"] = self.metrics.summary()
+        if self.profiler is not None:
+            # Per-operator attribution: which of the fused ops the stage
+            # time went to, device sub-phases, per-stage coverage.
+            summary["profile"] = self.profiler.summary(
+                {s.stage_id: s.seconds for s in self.stats})
         if self.flightrec is not None and self.flightrec.path:
             summary["crashdump_file"] = self.flightrec.path
         if self.tracer is not None:
             summary["spans"] = self.tracer.span_summary()
+            # Critical-path verdicts: per-stage and whole-run dominant
+            # bottleneck from the span timeline (wall-clock interval
+            # unions, so concurrent lanes never double-count).
+            try:
+                from .obs import critpath as _critpath
+
+                summary["critpath"] = _critpath.analyze(
+                    summary, self.tracer.events)
+            except Exception:
+                log.warning("critical-path analysis failed", exc_info=True)
             tdir = _export.run_trace_dir(self.name)
             os.makedirs(tdir, exist_ok=True)
             summary["trace_file"] = _export.write_trace(
@@ -2382,6 +2512,13 @@ class MTRunner(object):
             _export.write_stats(summary, spath)
             log.info("trace: %s · stats: %s", summary["trace_file"], spath)
         self.run_summary = summary
+        if not self._run_failed:
+            # Run-history corpus: one compact record per FINALIZED run
+            # (failed runs would poison the adaptation medians) — the
+            # accumulated telemetry plan/cost.py and doctor consume.
+            from .obs import history as _history
+
+            _history.append(summary)
 
     def _run(self, outputs, cleanup=True):
         from . import resume as _resume
@@ -2511,6 +2648,16 @@ class MTRunner(object):
             if isinstance(stage, GInput):
                 env[stage.output] = stage.tap
                 continue
+            if self.profiler is not None:
+                # Per-operator attribution context: the stage walk is
+                # sequential, so the profiler's current-stage pointer is
+                # exact; provenance (the original user stages a fused
+                # node absorbed) rides the node from plan fusion.
+                from .plan import ir as _plan_ir
+
+                self.profiler.begin_stage(
+                    sid, _plan_ir.stage_kind(stage),
+                    provenance=_plan_ir.stage_provenance(stage))
             if _metrics.enabled():
                 # The progress line's live stage view + a sampled stage
                 # gauge, so the time series shows stage boundaries.

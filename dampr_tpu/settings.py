@@ -328,6 +328,15 @@ lower = os.environ.get("DAMPR_TPU_LOWER", "auto")
 _resolved_lower = None
 
 
+def lower_forced():
+    """Was lowering EXPLICITLY forced on ("1"/"on")?  A forced switch
+    wins over the stats-driven placement floor (``lower_min_records``):
+    the operator asked for device execution, so accumulated history must
+    not silently pin eligible stages back to host — only ``auto`` mode
+    is cost-driven."""
+    return str(lower).lower() in ("on", "1", "true", "yes")
+
+
 def lower_enabled():
     """Is device lowering in force?  Auto resolves by backend the same
     way the HBM tier does (never through a possibly-unhealthy remote
@@ -447,6 +456,34 @@ trace = os.environ.get("DAMPR_TPU_TRACE", "0").lower() not in (
 #: under the run's scratch root, next to its durable spill/checkpoint
 #: outputs; a path pins every run's artifacts under <trace_dir>/<run>/.
 trace_dir = os.environ.get("DAMPR_TPU_TRACE_DIR") or None
+
+#: Per-operator profiler (dampr_tpu.obs.profile): when True every run
+#: attributes wall time and record counts to the INDIVIDUAL user ops a
+#: fused stage was built from — each composed ``apply_batch`` step, each
+#: codec window per scanner, map-side partial/final folds, and the
+#: device programs' build/h2d/compute/d2h sub-phases — and ships the
+#: result as ``stats()["profile"]`` (plus the run-history corpus).  Off
+#: (the default) every instrumentation site is one module-global
+#: None-check, same contract as ``trace``/``metrics_interval_ms``; the
+#: timers are per-batch/per-window, never per-record, so the on-path
+#: overhead stays within the ≤3% bench gate.
+profile = os.environ.get("DAMPR_TPU_PROFILE", "0").lower() not in (
+    "0", "false", "no", "off", "")
+
+#: Run-history corpus (dampr_tpu.obs.history): every finalized run
+#: appends one compact summary record (plan fingerprint + stage shapes,
+#: per-stage IO, critical-path verdicts, per-op profile, throughput,
+#: settings snapshot) to ``<scratch_root>/<run>/history.jsonl``, bounded
+#: to this many entries (oldest rewritten away past it).  The corpus
+#: feeds ``plan/cost.py`` adaptation (median over matching runs instead
+#: of one stats.json) and ``dampr-tpu-doctor --diff``.  0 disables
+#: corpus writes entirely.
+history_entries = int(os.environ.get("DAMPR_TPU_HISTORY_ENTRIES", "64"))
+
+#: Recency bound for corpus-driven adaptation: only the most recent this-
+#: many shape-matching records feed the per-stage medians (old runs under
+#: different data volumes should age out of the estimate).
+history_window = int(os.environ.get("DAMPR_TPU_HISTORY_WINDOW", "8"))
 
 #: Live metrics plane (dampr_tpu.obs.metrics): sampling cadence in
 #: milliseconds for the background gauge sampler.  0 (the default)

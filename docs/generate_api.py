@@ -42,6 +42,10 @@ MODULES = [
     "dampr_tpu.obs.promtext",
     "dampr_tpu.obs.flightrec",
     "dampr_tpu.obs.export",
+    "dampr_tpu.obs.profile",
+    "dampr_tpu.obs.critpath",
+    "dampr_tpu.obs.history",
+    "dampr_tpu.obs.doctor",
     "dampr_tpu.resume",
     "dampr_tpu.settings",
     "dampr_tpu.ops.hashing",

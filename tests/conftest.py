@@ -34,6 +34,20 @@ from dampr_tpu import settings as _settings  # noqa: E402
 
 _settings.device_min_batch = 4096
 
+# Session-fresh scratch root: the run-history corpus (obs.history) and
+# resume checkpoints persist under scratch across pytest SESSIONS, so a
+# shared /tmp/dampr_tpu would let a previous session's records steer
+# stats-driven adaptation inside this one (fixed run names are reused
+# all over the suite).  Within one session behavior is unchanged —
+# tests still share one root, which the cross-run resume/adaptive tests
+# rely on.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+_settings.scratch_root = tempfile.mkdtemp(prefix="dampr-tpu-tests-")
+atexit.register(shutil.rmtree, _settings.scratch_root, True)
+
 import pytest  # noqa: E402
 
 #: The reference repo's README, used by several kernels tests as a natural-

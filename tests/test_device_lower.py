@@ -386,6 +386,45 @@ class TestPlanAnalysis:
         assert got["target"] == "host"
         assert "lower_min_records" in got["reason"]
 
+    def test_forced_lowering_ignores_history_floor(self, corpus,
+                                                   tmp_path):
+        """An explicit DAMPR_TPU_LOWER=1 wins over accumulated run
+        history: the stats floor (lower_min_records) is an AUTO-mode
+        behavior, so a tiny prior run recorded in the history corpus
+        must not silently pin a forced run's eligible stage back to
+        host (regression: the corpus — which untraced runs now feed —
+        would otherwise flip device_stages to 0 on every rerun of a
+        small named pipeline)."""
+        from dampr_tpu.obs import history as obs_history
+
+        old_scratch = settings.scratch_root
+        settings.scratch_root = str(tmp_path / "scratch")
+        settings.lower = "1"
+        try:
+            name = "lowertest-forced-history"
+
+            def pipe():
+                docs = Dampr.text(corpus, os.path.getsize(corpus))
+                return (docs.custom_mapper(
+                    DocFreq(mode="word", lower=True, pair_values=False))
+                    .fold_values(operator.add))
+
+            em1 = pipe().run(name)
+            s1 = em1.stats()
+            em1.delete()
+            assert s1["device"]["device_stages"] >= 1, s1["device"]
+            # the finalized run recorded tiny history under this name...
+            assert obs_history.load(name)
+            # ...and a rerun STILL lowers (forced mode skips the floor)
+            em2 = pipe().run(name)
+            s2 = em2.stats()
+            em2.delete()
+            assert s2["device"]["device_stages"] >= 1, s2["device"]
+        finally:
+            settings.lower = "auto"
+            settings._resolved_lower = None
+            settings.scratch_root = old_scratch
+
     def test_explain_renders_targets(self, corpus):
         docs = Dampr.text(corpus, os.path.getsize(corpus))
         pipe = (docs.custom_mapper(

@@ -1,0 +1,448 @@
+"""Diagnosis layer: critical-path analysis (dampr_tpu.obs.critpath),
+run-history corpus (obs.history) + corpus-driven cost adaptation
+equivalence pins, and the dampr-tpu-doctor CLI (report shape, schema
+validity, suggestion knobs, --diff)."""
+
+import importlib.util
+import json
+import operator
+import os
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.obs import critpath, doctor, export, history
+from dampr_tpu.ops.devtime import union_seconds
+from dampr_tpu.plan import cost
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate_doctor = _load_tool("validate_doctor")
+
+with open(os.path.join(ROOT, "docs", "doctor_schema.json")) as _f:
+    DOCTOR_SCHEMA = json.load(_f)
+
+
+@pytest.fixture
+def diagnosed(tmp_path):
+    """Tracing + isolated scratch (history corpus is per scratch root)."""
+    old = (settings.trace, settings.trace_dir, settings.scratch_root)
+    settings.trace = True
+    settings.trace_dir = str(tmp_path / "traces")
+    settings.scratch_root = str(tmp_path / "scratch")
+    yield tmp_path
+    settings.trace, settings.trace_dir, settings.scratch_root = old
+
+
+def _corpus(tmp_path, lines=6000):
+    path = tmp_path / "corpus.txt"
+    words = ["alpha", "beta", "gamma", "delta", "tok7", "zz", "mu", "xi"]
+    with open(path, "w") as f:
+        for i in range(lines):
+            f.write(" ".join(words[(i + j) % len(words)]
+                             for j in range(9)) + "\n")
+    return str(path)
+
+
+def _tfidf_run(tmp_path, name="doc-tfidf"):
+    import math
+
+    docs = Dampr.text(_corpus(tmp_path), 1 << 17)
+    from dampr_tpu.ops.text import DocFreq
+
+    df = (docs.custom_mapper(DocFreq(mode="word", lower=True))
+          .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
+    idf = df.cross_right(
+        docs.len(),
+        lambda d, total: (d[0], d[1], math.log(1 + float(total) / d[1])),
+        memory=True)
+    return idf.run(name)
+
+
+class TestUnionSeconds:
+    def test_disjoint_overlap_nested(self):
+        assert union_seconds([]) == 0.0
+        assert union_seconds([(0, 1), (2, 3)]) == 2.0
+        assert union_seconds([(0, 2), (1, 3)]) == 3.0
+        assert union_seconds([(0, 10), (2, 3), (4, 5)]) == 10.0
+        # degenerate/reversed intervals contribute nothing
+        assert union_seconds([(1, 1), (3, 2)]) == 0.0
+
+    def test_never_exceeds_span(self):
+        import random
+
+        rng = random.Random(7)
+        iv = [(a, a + rng.random())
+              for a in (rng.random() * 10 for _ in range(50))]
+        u = union_seconds(iv)
+        lo = min(a for a, _ in iv)
+        hi = max(b for _, b in iv)
+        assert 0 <= u <= hi - lo + 1e-9
+
+
+class TestCritpath:
+    def test_synthetic_span_verdicts(self):
+        """Hand-built events: stage 0 is codec-bound (two concurrent
+        codec lanes must union, not sum), stage 1 is spill-queue-bound
+        through the io_wait writer-backpressure spans."""
+        ev = [
+            ("stage", "s0:map", 0.0, 10.0, "stages", None),
+            # two overlapping codec lanes: union 8s of 10s wall
+            ("codec", "codec-window", 0.0, 6.0, 1, None),
+            ("codec", "codec-window", 2.0, 6.0, 2, None),
+            ("fold", "partial-fold", 6.0, 1.0, 1, None),
+            ("stage", "s1:reduce", 10.0, 5.0, "stages", None),
+            ("io_wait", "writer-backpressure", 10.5, 4.0, 3, None),
+        ]
+        section = critpath.analyze({"wall_seconds": 15.0}, ev)
+        assert section["source"] == "spans"
+        by = {s["stage"]: s for s in section["stages"]}
+        assert by[0]["verdict"] == "codec"
+        assert abs(by[0]["fractions"]["codec"] - 0.8) < 0.01
+        assert by[1]["verdict"] == "spill-queue"
+        assert section["run"]["verdict"] == "codec"
+
+    def test_unattributed_wall_is_host_compute(self):
+        ev = [("stage", "s2:map", 0.0, 4.0, "stages", None),
+              ("codec", "w", 0.0, 0.5, 1, None)]
+        section = critpath.analyze({"wall_seconds": 4.0}, ev)
+        st = section["stages"][0]
+        assert st["verdict"] == "host-compute"
+        assert st["fractions"]["host-compute"] > 0.8
+
+    def test_persisted_trace_events_accepted(self):
+        """Chrome-format dict events (microseconds) normalize the same
+        as live tuples."""
+        ev = [{"ph": "X", "cat": "stage", "name": "s0:map",
+               "ts": 0, "dur": 2e6},
+              {"ph": "X", "cat": "merge", "name": "gen",
+               "ts": 0, "dur": 1.5e6},
+              {"ph": "i", "cat": "retry", "name": "x", "ts": 5}]
+        section = critpath.analyze({"wall_seconds": 2.0}, ev)
+        assert section["stages"][0]["verdict"] == "merge"
+
+    def test_summary_only_degrades(self):
+        section = critpath.analyze({
+            "wall_seconds": 10.0,
+            "devtime": {"codec_wait": 3.0},
+            "io": {"io_wait_fraction": 0.1, "io_wait_write_fraction": 0.1},
+            "device": {"device_fraction": 0.0},
+            "stages": [{"stage": 1, "kind": "map", "seconds": 9.0,
+                        "target": "host"}],
+        }, events=None)
+        assert section["source"] == "summary"
+        assert section["run"]["verdict"] == "host-compute"
+        assert section["run"]["fractions"]["overlap-stall"] == 0.3
+
+    def test_traced_run_names_verdict_per_stage(self, diagnosed,
+                                                tmp_path):
+        """Acceptance shape: on a traced TF-IDF run every executed stage
+        gets a named verdict and the dominant map stage's attribution
+        is span-backed."""
+        em = _tfidf_run(tmp_path)
+        section = em.stats()["critpath"]
+        assert section["source"] == "spans"
+        stages = em.stats()["stages"]
+        assert len(section["stages"]) == len(stages)
+        for s in section["stages"]:
+            assert s["verdict"], s
+        heavy = max(section["stages"], key=lambda s: s["seconds"])
+        if heavy["seconds"] > 0.05:
+            # span-backed attribution on a meaningful window (sub-ms
+            # stages are all fixed overhead and legitimately read as
+            # host-compute)
+            assert heavy["attributed_fraction"] > 0.3, heavy
+        assert section["run"]["verdict"], section["run"]
+        em.delete()
+
+
+class TestHistoryCorpus:
+    def _summary(self, run="h-run", wall=2.0, bytes_in=1 << 20,
+                 shapes=None):
+        return {
+            "run": run, "started_at": 1.0, "wall_seconds": wall,
+            "n_partitions": 8,
+            "stages": [{"stage": 1, "kind": "map", "target": "host",
+                        "jobs": 2, "records_in": 10, "records_out": 100,
+                        "bytes_in": bytes_in, "bytes_out": 2 * bytes_in,
+                        "spill_bytes": 0, "seconds": wall / 2}],
+            "totals": {"records_out": 100, "bytes_out": 2 * bytes_in,
+                       "spill_bytes": 0},
+            "plan": {"stage_shapes": shapes or [
+                {"sid": 1, "shape": "map:DocFreq"}]},
+        }
+
+    def test_append_load_roundtrip(self, diagnosed):
+        path = history.append(self._summary())
+        assert path and os.path.isfile(path)
+        recs = history.load("h-run")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["schema"] == history.SCHEMA
+        assert rec["stages"][0]["bytes_in"] == 1 << 20
+        assert rec["fingerprint"] == history.plan_fingerprint(
+            rec["stage_shapes"])
+        assert rec["settings"]["partitions"] == settings.partitions
+
+    def test_corrupt_lines_skipped(self, diagnosed):
+        path = history.append(self._summary())
+        with open(path, "a") as f:
+            f.write("{not json\n")
+            f.write(json.dumps({"schema": "other/1", "stages": []}) + "\n")
+        history.append(self._summary(wall=3.0))
+        recs = history.load("h-run")
+        assert len(recs) == 2
+        assert [r["wall_seconds"] for r in recs] == [2.0, 3.0]
+
+    def test_bounded(self, diagnosed, monkeypatch):
+        monkeypatch.setattr(settings, "history_entries", 4)
+        for i in range(9):
+            history.append(self._summary(wall=float(i)))
+        recs = history.load("h-run")
+        assert len(recs) == 4
+        assert [r["wall_seconds"] for r in recs] == [5.0, 6.0, 7.0, 8.0]
+
+    def test_disabled_by_zero(self, diagnosed, monkeypatch):
+        monkeypatch.setattr(settings, "history_entries", 0)
+        assert history.append(self._summary()) is None
+        assert history.load("h-run") == []
+
+    def test_matching_filters_by_shape(self, diagnosed):
+        history.append(self._summary())
+        history.append(self._summary(
+            shapes=[{"sid": 1, "shape": "map:Other"}]))
+        recs = history.load("h-run")
+        assert len(recs) == 2
+        m = history.matching(recs, [{"sid": 1, "shape": "map:DocFreq"}])
+        assert len(m) == 1
+
+    def test_synthesize_single_is_verbatim_median_at_three(self,
+                                                           diagnosed):
+        """<3 records: newest verbatim (the old single-stats behavior);
+        >=3: per-stage medians."""
+        r1 = history.compact_record(self._summary(bytes_in=100))
+        r2 = history.compact_record(self._summary(bytes_in=900))
+        r3 = history.compact_record(self._summary(bytes_in=300))
+        one = history.synthesize([r1])
+        assert one["stages"][0]["bytes_in"] == 100
+        assert one["history_entries"] == 1
+        two = history.synthesize([r1, r2])
+        assert two["stages"][0]["bytes_in"] == 900  # newest, not mean
+        three = history.synthesize([r1, r2, r3])
+        assert three["stages"][0]["bytes_in"] == 300  # median of 100/900/300
+        assert three["history_entries"] == 3
+
+
+class TestCorpusDrivenAdaptation:
+    def test_single_entry_reproduces_stats_behavior(self, diagnosed,
+                                                    tmp_path):
+        """Equivalence pin: with exactly one corpus entry, the history
+        fed to adaptation carries the same per-stage measurements the
+        stats.json path would have provided — decision-for-decision
+        identical inputs."""
+        em = (Dampr.memory(list(range(4096)))
+              .map(lambda x: (x % 7, 1))
+              .fold_by(lambda kv: kv[0], binop=operator.add,
+                       value=lambda kv: kv[1])
+              .run("adapt-pin"))
+        em.delete()
+        assert len(history.load("adapt-pin")) == 1
+        stats_summary, _ = export.load_stats("adapt-pin")
+        assert stats_summary is not None
+
+        class G(object):
+            stages = []
+
+        # same graph shapes as the recorded run: reuse the recorded ones
+        rec_shapes = history.load("adapt-pin")[0]["stage_shapes"]
+        from dampr_tpu.plan import ir as plan_ir
+
+        real_shapes = plan_ir.stage_shapes
+        try:
+            plan_ir.stage_shapes = lambda g: rec_shapes
+            hist, reason = cost.corpus_history("adapt-pin", G())
+        finally:
+            plan_ir.stage_shapes = real_shapes
+        assert reason is None and hist is not None
+        by_corpus = {s["stage"]: s for s in hist["stages"]}
+        by_stats = {s["stage"]: s for s in stats_summary["stages"]}
+        assert set(by_corpus) == set(by_stats)
+        for sid, st in by_stats.items():
+            for field in ("records_in", "records_out", "bytes_in",
+                          "bytes_out"):
+                assert by_corpus[sid][field] == st[field], (sid, field)
+
+    def test_shape_mismatch_reason(self, diagnosed):
+        history.append({
+            "run": "adapt-mm", "started_at": 1.0, "wall_seconds": 1.0,
+            "n_partitions": 4,
+            "stages": [{"stage": 1, "kind": "map", "seconds": 1.0}],
+            "totals": {},
+            "plan": {"stage_shapes": [{"sid": 1, "shape": "map:X"}]},
+        })
+
+        class G(object):
+            stages = []
+
+        hist, reason = cost.corpus_history("adapt-mm", G())
+        assert hist is None and reason == "shape-mismatch"
+
+    def test_no_history_reason(self, diagnosed):
+        class G(object):
+            stages = []
+
+        hist, reason = cost.corpus_history("never-ran", G())
+        assert hist is None and reason == "no-history"
+
+
+class TestDoctor:
+    def test_playbook_knobs_exist(self):
+        """Every suggestion in the taxonomy names a real settings
+        attribute (the acceptance bar: suggestions are actionable)."""
+        for verdict, entries in doctor._PLAYBOOK.items():
+            for knob, _env, _prop, why in entries:
+                assert hasattr(settings, knob), (verdict, knob)
+                assert why
+
+    def test_diagnose_traced_run_schema_valid(self, diagnosed, tmp_path):
+        em = _tfidf_run(tmp_path, name="doc-run")
+        em.delete()
+        report = doctor.diagnose("doc-run")
+        errors = validate_doctor.validate(report, DOCTOR_SCHEMA)
+        assert errors == [], errors
+        assert report["bottleneck"]
+        assert report["stages"]
+        # >=1 actionable suggestion whose knob exists (acceptance)
+        suggestions = [s for f in report["findings"]
+                       for s in f["suggestions"]]
+        assert suggestions
+        for s in suggestions:
+            assert hasattr(settings, s["setting"]), s
+        # human rendering never crashes and names the bottleneck
+        text = doctor.format_report(report)
+        assert report["bottleneck"] in text
+
+    def test_findings_ranked_by_impact(self, diagnosed, tmp_path):
+        em = _tfidf_run(tmp_path, name="doc-rank")
+        em.delete()
+        report = doctor.diagnose("doc-rank")
+        impacts = [f["impact_seconds"] for f in report["findings"]]
+        assert impacts == sorted(impacts, reverse=True)
+        assert [f["rank"] for f in report["findings"]] == list(
+            range(1, len(impacts) + 1))
+
+    def test_suggestions_use_run_settings_not_process(self):
+        """'current -> suggested' is computed from the DIAGNOSED run's
+        recorded knobs, not whatever the doctor process happens to have
+        (a doctor on another machine must not advise below the value
+        that was already the bottleneck)."""
+        rs = {"spill_write_threads": 8}
+        sugg = doctor._suggestions_for("spill-queue", {}, run_settings=rs)
+        by = {s["setting"]: s for s in sugg}
+        assert by["spill_write_threads"]["current"] == 8
+        assert by["spill_write_threads"]["suggested"] == 16
+
+    def test_run_settings_sources(self):
+        summary = {"io": {"writer_threads": 5, "read_prefetch": 7},
+                   "overlap": {"windows": 9},
+                   "metrics": {"sampler": {"interval_ms": 250}}}
+        hist = [{"settings": {"spill_write_threads": 1, "partitions": 32}}]
+        rs = doctor._run_settings(summary, hist)
+        # summary-sourced values beat the corpus snapshot
+        assert rs["spill_write_threads"] == 5
+        assert rs["spill_read_prefetch"] == 7
+        assert rs["overlap_windows"] == 9
+        assert rs["metrics_interval_ms"] == 250
+        assert rs["partitions"] == 32
+
+    def test_threadseconds_impact_clamped_to_wall(self, diagnosed,
+                                                  tmp_path):
+        """io_wait_write_seconds is thread-seconds and can exceed run
+        wall; the run-level finding's impact must stay on the wall
+        axis the stage findings rank on."""
+        stats = {
+            "schema": "dampr-tpu-stats/1", "run": "clamp-run",
+            "wall_seconds": 10.0, "stages": [],
+            "io": {"io_wait_write_fraction": 1.6,
+                   "io_wait_write_seconds": 16.0,
+                   "io_wait_fraction": 1.6},
+            "devtime": {}, "overlap": {}, "device": {},
+        }
+        p = tmp_path / "stats.json"
+        with open(p, "w") as f:
+            json.dump(stats, f)
+        rep = doctor.diagnose(str(p))
+        f = [x for x in rep["findings"]
+             if x["bottleneck"] == "spill-queue"]
+        assert f, rep["findings"]
+        assert f[0]["impact_seconds"] <= 10.0
+        assert "thread-seconds" in f[0]["evidence"]
+
+    def test_no_duplicate_runlevel_findings(self, diagnosed, tmp_path):
+        """A per-stage verdict and its run-level mirror are ONE root
+        cause: run-level spill-queue/overlap-stall findings are
+        suppressed when a stage already names them."""
+        em = _tfidf_run(tmp_path, name="doc-dedup")
+        em.delete()
+        rep = doctor.diagnose("doc-dedup")
+        staged = {f["bottleneck"] for f in rep["findings"]
+                  if f["stage"] is not None}
+        runlevel = [f["bottleneck"] for f in rep["findings"]
+                    if f["stage"] is None and f["bottleneck"] !=
+                    "host-compute"]
+        assert not (staged & set(runlevel)), rep["findings"]
+
+    def test_missing_run_raises(self, diagnosed):
+        with pytest.raises(doctor.DoctorError):
+            doctor.diagnose("no-such-run")
+
+    def test_cli_exit_codes(self, diagnosed, tmp_path, capsys):
+        assert doctor.main(["no-such-run"]) == 2
+        em = _tfidf_run(tmp_path, name="doc-cli")
+        em.delete()
+        assert doctor.main(["doc-cli"]) == 0
+        out = capsys.readouterr().out
+        assert "bottleneck" in out
+        assert doctor.main(["doc-cli", "--json"]) == 0
+        out = capsys.readouterr().out
+        report = json.loads(out)
+        assert report["schema"] == doctor.SCHEMA
+
+    def test_diff(self, diagnosed, tmp_path, capsys):
+        em = _tfidf_run(tmp_path, name="diff-a")
+        em.delete()
+        em = _tfidf_run(tmp_path, name="diff-b")
+        em.delete()
+        report = doctor.diff("diff-a", "diff-b")
+        errors = validate_doctor.validate(report, DOCTOR_SCHEMA)
+        assert errors == [], errors
+        d = report["diff"]
+        assert d["run_a"] == "diff-a" and d["run_b"] == "diff-b"
+        assert d["stages"]
+        # same settings both runs -> no recorded delta
+        assert d["settings_delta"] == {}
+        text = doctor.format_report(report)
+        assert "diff-a" in text and "diff-b" in text
+        assert doctor.main(["--diff", "diff-a", "diff-b"]) == 0
+        capsys.readouterr()
+
+    def test_diff_surfaces_settings_change(self, diagnosed, tmp_path,
+                                           monkeypatch):
+        em = _tfidf_run(tmp_path, name="diff-s1")
+        em.delete()
+        old = settings.overlap_windows
+        monkeypatch.setattr(settings, "overlap_windows", old + 5)
+        em = _tfidf_run(tmp_path, name="diff-s2")
+        em.delete()
+        report = doctor.diff("diff-s1", "diff-s2")
+        delta = report["diff"]["settings_delta"]
+        assert delta.get("overlap_windows") == {"a": old, "b": old + 5}

@@ -1,0 +1,251 @@
+"""Obs-plane hardening satellites: Prometheus text-format escaping and
+empty expositions (obs.promtext), counter-event epoch clamping
+(obs.export), dampr-tpu-stats --series on degenerate runs, and the
+check_bench --trend trajectory gate."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from dampr_tpu import settings
+from dampr_tpu.obs import export, promtext
+from dampr_tpu.obs.metrics import Metrics
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate_trace = _load_tool("validate_trace")
+check_bench = _load_tool("check_bench")
+
+with open(os.path.join(ROOT, "docs", "trace_schema.json")) as _f:
+    TRACE_SCHEMA = json.load(_f)
+
+
+class TestPromtextEscaping:
+    def test_label_value_escapes(self):
+        assert promtext.escape_label_value('a\\b') == 'a\\\\b'
+        assert promtext.escape_label_value('a"b') == 'a\\"b'
+        assert promtext.escape_label_value('a\nb') == 'a\\nb'
+        # order matters: the backslash introduced by the quote escape
+        # must not be re-escaped
+        assert promtext.escape_label_value('\\"') == '\\\\\\"'
+
+    def test_run_label_with_hostile_name_renders_one_line_per_sample(self):
+        out = promtext.render_summary({
+            "run": 'bad"run\nname\\x',
+            "metrics": {"counters": {"store.records": 5}},
+        })
+        lines = out.splitlines()
+        # exactly TYPE + sample: a raw newline in the label would split
+        # the sample line and corrupt the exposition
+        assert len(lines) == 2
+        assert lines[0] == "# TYPE dampr_tpu_store_records_total counter"
+        assert '\\"run\\nname\\\\x' in lines[1]
+        assert lines[1].endswith(" 5.0")
+
+    def test_histograms_carry_type_lines(self):
+        out = promtext.render_summary({
+            "run": "r",
+            "metrics": {"histograms": {
+                "merge.fanin": {"count": 3, "sum": 12.0,
+                                "min": 2, "max": 6}}},
+        })
+        lines = out.splitlines()
+        assert "# TYPE dampr_tpu_merge_fanin summary" in lines
+        assert "# TYPE dampr_tpu_merge_fanin_min gauge" in lines
+        assert "# TYPE dampr_tpu_merge_fanin_max gauge" in lines
+        assert any(l.startswith('dampr_tpu_merge_fanin_count{run="r"} 3')
+                   for l in lines)
+        # every sample line is preceded (somewhere) by its TYPE
+        for l in lines:
+            if l.startswith("#"):
+                assert l.split()[1] == "TYPE"
+
+    def test_empty_exposition_is_valid_and_empty(self):
+        # no metrics section at all
+        assert promtext.render_summary({"run": "r"}) == ""
+        # a metrics section with nothing in it
+        assert promtext.render_summary({"run": "r", "metrics": {}}) == ""
+        # a live registry with no samples renders without crashing
+        m = Metrics("empty-run")
+        out = promtext.render(m)
+        assert isinstance(out, str)
+        for line in out.splitlines():
+            assert line.startswith("#") or " " in line
+
+
+class TestCounterEpochClamp:
+    def test_pre_epoch_samples_clamp_to_zero(self):
+        """A sample recorded before the (re-pointed) epoch must not emit
+        a negative Chrome ts — clamp to the run origin."""
+        m = Metrics("clamp-run")
+        # simulate the sampler's first tick landing BEFORE the tracer's
+        # run epoch: relative timestamps go negative
+        m.series["writer.queue_depth"] = [(-0.25, 3), (-0.1, 4), (0.2, 5)]
+        events = export.counter_events(m)
+        assert len(events) == 3
+        ts = [ev["ts"] for ev in events]
+        assert ts == [0.0, 0.0, pytest.approx(0.2e6)]
+        assert all(t >= 0 for t in ts)
+
+    def test_clamped_trace_validates(self, tmp_path):
+        """The clamped document passes the schema + per-series monotonic
+        pin (two clamped samples are non-decreasing at 0)."""
+        from dampr_tpu.obs.trace import Tracer
+
+        tracer = Tracer("clamp-run")
+        with_span = tracer.span("stage", "s0:map", lane="stages")
+        with with_span:
+            pass
+        m = Metrics("clamp-run")
+        m.series["g"] = [(-0.5, 1), (0.1, 2)]
+        path = str(tmp_path / "trace.json")
+        export.write_trace(tracer, path, metrics=m)
+        with open(path) as f:
+            doc = json.load(f)
+        errors = validate_trace.validate(doc, TRACE_SCHEMA)
+        assert errors == [], errors
+        cs = [ev for ev in doc["traceEvents"] if ev.get("ph") == "C"]
+        assert [ev["ts"] for ev in cs] == [0.0, pytest.approx(1e5)]
+
+
+class TestSeriesDegenerate:
+    def _run_dir_with_trace(self, tmp_path, events, run="deg-run"):
+        d = tmp_path / "trace"
+        d.mkdir(parents=True)
+        trace = {"traceEvents": events, "displayTimeUnit": "ms",
+                 "otherData": {"run": run}}
+        tp = d / "trace.json"
+        with open(tp, "w") as f:
+            json.dump(trace, f)
+        stats = {"schema": "dampr-tpu-stats/1", "run": run,
+                 "wall_seconds": 1.0, "stages": [],
+                 "trace_file": str(tp), "stats_file": str(d / "stats.json")}
+        with open(d / "stats.json", "w") as f:
+            json.dump(stats, f)
+        return str(tmp_path)
+
+    def _cli(self, argv, monkeypatch, capsys):
+        import sys
+
+        from dampr_tpu import cli
+
+        monkeypatch.setattr(sys, "argv", ["dampr-tpu-stats"] + argv)
+        rc = 0
+        try:
+            cli.stats()
+        except SystemExit as e:
+            rc = e.code or 0
+        out = capsys.readouterr()
+        return rc, out.out + out.err
+
+    def test_single_sample_series(self, tmp_path, monkeypatch, capsys):
+        run_dir = self._run_dir_with_trace(tmp_path, [
+            {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "t"}},
+            {"ph": "C", "name": "g", "cat": "metric", "pid": 1, "tid": 0,
+             "ts": 100.0, "args": {"value": 7}},
+        ])
+        rc, out = self._cli([run_dir, "--series"], monkeypatch, capsys)
+        assert rc == 0
+        assert "g" in out and "7" in out
+
+    def test_all_zero_counters(self, tmp_path, monkeypatch, capsys):
+        events = [{"ph": "C", "name": "z", "cat": "metric", "pid": 1,
+                   "tid": 0, "ts": float(i) * 1000,
+                   "args": {"value": 0}} for i in range(5)]
+        run_dir = self._run_dir_with_trace(tmp_path, events)
+        rc, out = self._cli([run_dir, "--series"], monkeypatch, capsys)
+        assert rc == 0
+        # a flat zero series renders (flat sparkline), no ZeroDivision
+        assert "z" in out
+
+    def test_spans_but_no_counters_reports_no_series(self, tmp_path,
+                                                     monkeypatch, capsys):
+        run_dir = self._run_dir_with_trace(tmp_path, [
+            {"ph": "X", "cat": "stage", "name": "s0:map", "pid": 1,
+             "tid": 1, "ts": 0.0, "dur": 1000.0},
+        ])
+        rc, out = self._cli([run_dir, "--series"], monkeypatch, capsys)
+        assert rc == 0
+        assert "no counter samples" in out
+
+    def test_format_series_degenerate_units(self):
+        assert "no counter samples" in export.format_series({})
+        one = export.format_series({"a": [(0.0, 5.0)]})
+        assert "a" in one
+        flat = export.format_series({"z": [(0.0, 0.0), (1.0, 0.0)]})
+        assert "z" in flat
+
+
+class TestCheckBenchTrend:
+    def _rec(self, v, metric="mbps"):
+        return {"metric": metric, "value": v}
+
+    def test_monotone_decline_flags(self):
+        t = check_bench.trend(
+            self._rec(70), [("r1", self._rec(100)), ("r2", self._rec(90)),
+                            ("r3", self._rec(80))])
+        assert t["regressing"] is True
+        assert t["declining"] == 4
+
+    def test_recovery_resets(self):
+        t = check_bench.trend(
+            self._rec(95), [("r1", self._rec(100)), ("r2", self._rec(80)),
+                            ("r3", self._rec(90))])
+        # 80 -> 90 -> 95 is improving; only fresh vs r3 comparison counts
+        assert t["regressing"] is False
+
+    def test_short_history_notes(self):
+        t = check_bench.trend(self._rec(50), [("r1", self._rec(100))])
+        assert t["regressing"] is False
+        assert "at least 3" in t["note"]
+
+    def test_metric_mismatch_excluded(self):
+        t = check_bench.trend(
+            self._rec(70, metric="a"),
+            [("r1", self._rec(100, metric="b")),
+             ("r2", self._rec(90, metric="b")),
+             ("r3", self._rec(80, metric="a"))])
+        # only r3 + fresh comparable -> too short to trend
+        assert t["regressing"] is False
+        assert len(t["points"]) == 2
+
+    def test_main_trend_warn_only(self, tmp_path, capsys):
+        paths = []
+        for name, v in (("r1", 100), ("r2", 90), ("r3", 85),
+                        ("fresh", 80)):
+            p = tmp_path / (name + ".json")
+            with open(p, "w") as f:
+                json.dump(self._rec(v), f)
+            paths.append(str(p))
+        rc = check_bench.main(
+            [paths[-1], "--baseline"] + paths[:-1]
+            + ["--tolerance", "0.5", "--trend"])
+        out = capsys.readouterr().out
+        assert rc == 0  # warn-only: trend never changes the exit code
+        assert "TREND WARN" in out
+
+    def test_main_trend_quiet_when_healthy(self, tmp_path, capsys):
+        paths = []
+        for name, v in (("r1", 100), ("r2", 110), ("fresh", 120)):
+            p = tmp_path / (name + ".json")
+            with open(p, "w") as f:
+                json.dump(self._rec(v), f)
+            paths.append(str(p))
+        rc = check_bench.main(
+            [paths[-1], "--baseline"] + paths[:-1] + ["--trend"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "TREND WARN" not in out
+        assert "trend:" in out

@@ -20,12 +20,13 @@ class TestPackaging:
             assert os.path.isdir(path), pkg
         scripts = meta["project"]["scripts"]
         assert set(scripts) == {"dampr-tpu-bench", "dampr-tpu-wc",
-                                "dampr-tpu-tfidf", "dampr-tpu-stats"}
+                                "dampr-tpu-tfidf", "dampr-tpu-stats",
+                                "dampr-tpu-doctor"}
 
     def test_console_entry_points_import(self):
         from dampr_tpu import cli
 
-        for fn in (cli.bench, cli.wc, cli.tf_idf, cli.stats):
+        for fn in (cli.bench, cli.wc, cli.tf_idf, cli.stats, cli.doctor):
             assert callable(fn)
 
     def test_bench_driver_hook_is_thin_wrapper(self):

@@ -282,6 +282,14 @@ class TestKillSwitches:
 
 class TestAdaptive:
     def test_history_drives_sizing_and_results_stable(self, tmp_path):
+        # Session-unique name: the history corpus persists under the
+        # scratch root across pytest sessions, so a fixed name would
+        # make em1's adaptation depend on a PREVIOUS session's records
+        # (and, past three sessions, engage the median path on stale
+        # measurements from older code).
+        import uuid
+
+        name = "plan-adapt-{}".format(uuid.uuid4().hex)
         old_trace, old_dir = settings.trace, settings.trace_dir
         settings.trace = True
         settings.trace_dir = str(tmp_path)
@@ -292,9 +300,9 @@ class TestAdaptive:
                         .fold_by(lambda kv: kv[0], operator.add,
                                  lambda kv: kv[1]))
 
-            em1 = pipe().run(name="plan-adapt-test")
+            em1 = pipe().run(name=name)
             r1 = sorted(em1.read())
-            em2 = pipe().run(name="plan-adapt-test")
+            em2 = pipe().run(name=name)
             r2 = sorted(em2.read())
             ad = em2.stats()["plan"]["adaptive"]
             assert ad["applied"] is True
@@ -305,8 +313,13 @@ class TestAdaptive:
             settings.trace, settings.trace_dir = old_trace, old_dir
 
     def test_no_history_static_defaults(self):
+        # Unique per invocation: every finalized run now appends to the
+        # persistent history corpus under scratch, so a reused name
+        # (even pid-salted, across sessions) could find prior history.
+        import uuid
+
         em = (Dampr.memory([1, 2, 3]).map(lambda x: x)
-              .run(name="plan-no-history-{}".format(os.getpid())))
+              .run(name="plan-no-history-{}".format(uuid.uuid4().hex)))
         ad = em.stats()["plan"]["adaptive"]
         assert ad["applied"] is False
         assert ad["reason"] in ("no-history", "disabled")
@@ -323,9 +336,12 @@ class TestAdaptive:
                     .map(lambda x: (x % 3, x))
                     .fold_by(lambda kv: kv[0], operator.add,
                              lambda kv: kv[1]))
-            r1 = MTRunner("plan-pin-test", pipe.pmer.graph, n_partitions=7)
+            import uuid
+
+            name = "plan-pin-{}".format(uuid.uuid4().hex)
+            r1 = MTRunner(name, pipe.pmer.graph, n_partitions=7)
             r1.run([pipe.source])
-            r2 = MTRunner("plan-pin-test", pipe.pmer.graph, n_partitions=7)
+            r2 = MTRunner(name, pipe.pmer.graph, n_partitions=7)
             r2.run([pipe.source])
             assert r2.n_partitions == 7, "explicit partition count retuned"
         finally:

