@@ -1,6 +1,7 @@
 """ICI shuffle microbenchmark (BASELINE.md config: "shuffle all-to-all
 bandwidth"): times the full mesh keyed-fold program (local segment fold ->
-all_to_all -> final fold) and the ring all-reduce over the visible mesh.
+all_to_all -> final fold), the ring all-reduce, and the budget-scheduled
+byte exchange over the visible mesh.
 
 On a single chip the collectives are loopback (upper bound); on a real slice
 the same program measures ICI.  Run on the virtual CPU mesh for a
@@ -8,38 +9,94 @@ functional check:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python benchmarks/shuffle_bench.py --cpu
+
+Multi-process mode spawns N local OS processes that join one
+``jax.distributed`` deployment over a localhost coordinator (gloo CPU
+collectives — the same code path a TPU pod runs over DCN) and drives the
+byte exchange across the process boundary:
+
+    python benchmarks/shuffle_bench.py --cpu --mproc 2
+
+The JSON (one line, ``metric``/``value`` keyed for tools/check_bench.py)
+reports ``exchange_bytes``, ``exchange_steps``, ``peak_inflight_bytes``
+(the replan cost model's per-step high-water mark — asserted under
+``hbm_budget``), and MB/s.
 """
 
 import _pathfix  # noqa: F401  (repo root onto sys.path)
 
 import argparse
 import json
+import os
+import socket
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--records", type=int, default=1 << 22)
-    ap.add_argument("--keys", type=int, default=1 << 16)
-    ap.add_argument("--iters", type=int, default=5)
-    ap.add_argument("--cpu", action="store_true",
-                    help="force the virtual CPU mesh")
-    args = ap.parse_args()
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
-    import os
 
+def _exchange_blobs(n_dev, mb, seed=0):
+    """Synthetic routed payload: every (src, dst) pair carries an uneven
+    share of ``mb`` total megabytes (dst-skewed, so schedules see mixed
+    piece counts)."""
+    total = int(mb * 1e6)
+    rng = np.random.RandomState(seed)
+    weights = rng.rand(n_dev, n_dev) + 0.1
+    weights /= weights.sum()
+    blobs = {}
+    for s in range(n_dev):
+        for d in range(n_dev):
+            n = int(total * weights[s, d])
+            if n:
+                blobs[(s, d)] = rng.randint(
+                    0, 256, size=n).astype(np.uint8).tobytes()
+    return blobs
+
+
+def _bench_exchange(mesh, args):
+    """Time the scheduled byte exchange; returns the JSON fields."""
+    from dampr_tpu import settings
+    from dampr_tpu.parallel import exchange as px
+    from dampr_tpu.parallel.mesh import mesh_size
+
+    n_dev = mesh_size(mesh)
+    budget = (int(args.budget_mb * 1e6) if args.budget_mb
+              else settings.exchange_hbm_budget)
+    blobs = _exchange_blobs(n_dev, args.exchange_mb)
+    payload = sum(len(b) for b in blobs.values())
+    px.mesh_blob_exchange(mesh, blobs, budget=budget)  # warm (compile)
+    t0 = time.time()
+    for _ in range(args.iters):
+        out = px.mesh_blob_exchange(mesh, blobs, budget=budget)
+    ex_s = (time.time() - t0) / args.iters
+    assert sum(len(b) for b in out.values()) == payload, "exchange lost bytes"
+    info = px.last_info
+    return {
+        "exchange_bytes": payload,
+        "exchange_steps": info["steps"],
+        "peak_inflight_bytes": info["peak_inflight_bytes"],
+        "hbm_budget": budget,
+        "budget_respected": (info["peak_inflight_bytes"] <= budget
+                             and not info["clamped"]),
+        "exchange_MBps": round(payload / 1e6 / ex_s, 1),
+    }
+
+
+def _run_single(args):
     import jax
-
-    # honor --cpu and a JAX_PLATFORMS=cpu request even where the TPU plugin
-    # programmatically overrides jax_platforms at interpreter start
-    if args.cpu or "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
-        jax.config.update("jax_platforms", "cpu")
 
     from dampr_tpu.ops import hashing
     from dampr_tpu.parallel import mesh_keyed_fold
-    from dampr_tpu.parallel.mesh import data_mesh
+    from dampr_tpu.parallel.mesh import data_mesh, process_info
     from dampr_tpu.parallel.ring import ring_allreduce
 
     mesh = data_mesh()
@@ -58,20 +115,115 @@ def main():
     fold_s = (time.time() - t0) / args.iters
     assert int(fv.sum()) == args.records
 
-    x = rng.randn(n_dev * 1024, 256).astype(np.float32)
-    ring_allreduce(mesh, x)  # warm
-    t0 = time.time()
-    for _ in range(args.iters):
-        ring_allreduce(mesh, x)
-    ring_s = (time.time() - t0) / args.iters
-    ring_mb = x.nbytes / 1e6
-
-    print(json.dumps({
+    rec = {
+        "metric": "shuffle_exchange_MBps",
         "devices": n_dev,
+        "processes": process_info()["process_count"],
         "keyed_fold_MBps": round(payload_mb / fold_s, 1),
         "keyed_fold_records_per_s": round(args.records / fold_s),
-        "ring_allreduce_MBps": round(ring_mb / ring_s, 1),
-    }))
+    }
+    rec.update(_bench_exchange(mesh, args))
+    rec["value"] = rec["exchange_MBps"]
+
+    if jax.process_count() == 1:
+        x = rng.randn(n_dev * 1024, 256).astype(np.float32)
+        ring_allreduce(mesh, x)  # warm
+        t0 = time.time()
+        for _ in range(args.iters):
+            ring_allreduce(mesh, x)
+        ring_s = (time.time() - t0) / args.iters
+        rec["ring_allreduce_MBps"] = round(x.nbytes / 1e6 / ring_s, 1)
+    return rec
+
+
+def _spawn_mproc(args):
+    """Parent side of --mproc: spawn N worker ranks of this same script
+    joined through a localhost coordinator; rank 0's JSON line is the
+    result."""
+    port = _free_port()
+    env_base = dict(os.environ)
+    env_base.pop("XLA_FLAGS", None)
+    procs = []
+    for rank in range(args.mproc):
+        env = dict(env_base)
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                            % args.devices_per_proc)
+        env["DAMPR_TPU_COORDINATOR"] = "localhost:%d" % port
+        env["DAMPR_TPU_NUM_PROCESSES"] = str(args.mproc)
+        env["DAMPR_TPU_PROCESS_ID"] = str(rank)
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+        cmd += ["--records", str(args.records), "--keys", str(args.keys),
+                "--iters", str(args.iters),
+                "--exchange-mb", str(args.exchange_mb),
+                "--devices-per-proc", str(args.devices_per_proc)]
+        if args.budget_mb:
+            cmd += ["--budget-mb", str(args.budget_mb)]
+        if args.cpu:
+            cmd.append("--cpu")
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=600))
+    except subprocess.TimeoutExpired:
+        # A dead rank wedges its siblings in the collective — kill the
+        # whole deployment rather than leaking orphans until CI times out.
+        for q in procs:
+            q.kill()
+        raise
+    failed = any(p.returncode != 0 for p in procs)
+    for rank, (p, (out, err)) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            sys.stderr.write("rank %d failed:\n%s\n" % (rank, err[-4000:]))
+    if failed:
+        raise SystemExit(1)
+    # rank 0 prints the deployment's JSON line
+    line = [ln for ln in outs[0][0].splitlines() if ln.startswith("{")][-1]
+    print(line)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=1 << 22)
+    ap.add_argument("--keys", type=int, default=1 << 16)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--exchange-mb", type=float, default=8.0,
+                    help="total payload MB for the byte-exchange phase")
+    ap.add_argument("--budget-mb", type=float, default=0,
+                    help="exchange HBM budget override (MB); 0 = "
+                         "settings.exchange_hbm_budget")
+    ap.add_argument("--mproc", type=int, default=0,
+                    help="spawn N local processes joined via "
+                         "jax.distributed (gloo on CPU) and bench the "
+                         "exchange across the process boundary")
+    ap.add_argument("--devices-per-proc", type=int, default=4,
+                    help="virtual CPU devices per spawned process")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one --mproc rank
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU mesh")
+    args = ap.parse_args()
+
+    if args.mproc and not args.worker:
+        _spawn_mproc(args)
+        return
+
+    import jax
+
+    # honor --cpu and a JAX_PLATFORMS=cpu request even where the TPU plugin
+    # programmatically overrides jax_platforms at interpreter start
+    if args.cpu or "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        jax.config.update("jax_platforms", "cpu")
+
+    from dampr_tpu.parallel.mesh import maybe_init_distributed
+
+    maybe_init_distributed()  # joins the --mproc deployment when spawned
+
+    rec = _run_single(args)
+    if jax.process_index() == 0:
+        print(json.dumps(rec))
 
 
 if __name__ == "__main__":

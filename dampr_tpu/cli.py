@@ -34,7 +34,19 @@ import os
 import sys
 
 
+def _join_deployment():
+    """Env-gated multi-process join (no-op without a coordinator env):
+    any CLI dropped onto a pod rank with DAMPR_TPU_COORDINATOR /
+    JAX_COORDINATOR_ADDRESS wired joins the jax.distributed process
+    group before its first jax use — the same pipelines then span every
+    rank's devices with no other changes (docs/parallel.md)."""
+    from .parallel.mesh import maybe_init_distributed
+
+    maybe_init_distributed()
+
+
 def bench():
+    _join_deployment()
     from .bench_tfidf import main
     main()
 
@@ -68,6 +80,7 @@ def wc():
     args = ap.parse_args()
     if args.progress:
         _enable_progress()
+    _join_deployment()
 
     from . import Dampr
 
@@ -102,6 +115,7 @@ def tf_idf():
     args = ap.parse_args()
     if args.progress:
         _enable_progress()
+    _join_deployment()
 
     from . import Dampr
     from .ops.text import DocFreq

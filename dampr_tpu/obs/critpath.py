@@ -56,6 +56,7 @@ _RESOURCE_BY_CAT = {
     "spill_queue": "spill-queue",
     "merge": "merge",
     "collective": "mesh",
+    "exchange": "mesh",
     "hbm": "transfer",
     "stall": "overlap-stall",
     "checkpoint": "checkpoint",

@@ -121,9 +121,24 @@ _PLAYBOOK = {
          "and check worker-thread width"),
     ],
     "mesh": [
+        ("exchange_hbm_budget", "DAMPR_TPU_EXCHANGE_HBM",
+         lambda cur: max(64 * 1024 ** 2, int(cur or 0) * 2),
+         "collective exchange steps bound the run — a larger in-flight "
+         "budget lets the replan schedule move the same bytes in fewer, "
+         "bigger chunked collectives (device memory permitting)"),
+        ("exchange_chunk_bytes", "DAMPR_TPU_EXCHANGE_CHUNK",
+         lambda cur: None,
+         "or pin the per-piece chunk size explicitly when the device is "
+         "memory-pressured beyond what the in-flight model captures "
+         "(smaller chunks = more steps, lower peak)"),
+        ("exchange_min_bytes", "DAMPR_TPU_EXCHANGE_MIN_BYTES",
+         lambda cur: max(4 * 1024 ** 2, int(cur or 0) * 2),
+         "tiny shuffles pay D*D pack/unpack fixed costs — a higher "
+         "floor keeps them on the host path (auto mode; explicit "
+         "DAMPR_TPU_MESH_EXCHANGE=off pins every stage host)"),
         ("shuffle_capacity_factor", "",
          lambda cur: None,
-         "collective exchanges bound the run — tune exchange capacity "
+         "for the associative collective fold, tune exchange capacity "
          "or keep the shuffle on host (DAMPR_TPU_MESH_EXCHANGE=off)"),
     ],
 }

@@ -291,6 +291,13 @@ def format_summary(summary):
         add("mesh: {} collective folds, {} exchanges ({} moved)".format(
             mesh.get("folds", 0), mesh.get("exchanges", 0),
             _mb(mesh.get("exchange_bytes", 0))))
+        ex = mesh.get("exchange") or {}
+        if ex.get("steps"):
+            add("  exchange schedule: {} step(s), peak in-flight {} "
+                "(budget {})".format(
+                    ex.get("steps", 0),
+                    _mb(ex.get("peak_inflight_bytes", 0)),
+                    _mb(ex.get("hbm_budget", 0))))
     devx = summary.get("device", {})
     if devx.get("device_stages") or devx.get("device_fraction"):
         add("device: {} lowered stage(s) · device_fraction {:.2f} · "

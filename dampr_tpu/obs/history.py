@@ -50,7 +50,9 @@ _append_lock = threading.Lock()
 #: ``doctor --diff`` can attribute a regression to a config change.
 _KNOBS = ("partitions", "batch_size", "max_memory_per_stage",
           "overlap_windows", "spill_write_threads", "spill_read_prefetch",
-          "merge_fanin", "max_processes", "optimize", "profile")
+          "merge_fanin", "max_processes", "optimize", "profile",
+          "mesh_exchange", "exchange_hbm_budget", "exchange_chunk_bytes",
+          "exchange_min_bytes")
 
 
 def corpus_path(run_name):

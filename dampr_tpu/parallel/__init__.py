@@ -11,17 +11,32 @@ Here the exchange is XLA collectives over ICI/DCN:
   ``hash % n_devices``, then a final per-device fold.
 - :func:`dampr_tpu.parallel.shuffle.mesh_global_sum` — degenerate-key
   aggregates (len/sum) as a local reduce + ``psum``.
-- :mod:`dampr_tpu.parallel.mesh` — mesh construction helpers.
+- :mod:`dampr_tpu.parallel.exchange` — the general byte exchange (object-
+  valued blocks as payloads over all_to_all), executed as a budget-bounded
+  schedule of chunked collectives.
+- :mod:`dampr_tpu.parallel.replan` — the schedule planner: decomposes one
+  large redistribution into steps whose in-flight bytes respect
+  ``settings.exchange_hbm_budget`` (arXiv 2112.01075).
+- :mod:`dampr_tpu.parallel.mesh` — mesh construction + process-group
+  setup (``init_distributed`` / ``maybe_init_distributed`` /
+  ``process_info``): N processes join one deployment over a coordinator
+  (gloo TCP collectives on CPU hosts) and the same programs span every
+  rank's devices.
 
 The mesh abstraction is host-count-agnostic: the same program spans one chip,
 a v4-8 slice, or multi-host DCN — only the Mesh changes (SURVEY §7 hard
-part 5).
+part 5).  See ``docs/parallel.md``.
 """
 
+from . import replan
 from .exchange import mesh_blob_exchange, mesh_shuffle_blocks
-from .mesh import data_mesh, default_mesh, init_distributed
+from .mesh import (data_mesh, default_mesh, init_distributed,
+                   maybe_init_distributed, process_info)
+from .replan import plan_exchange, step_inflight_bytes
 from .shuffle import mesh_global_sum, mesh_keyed_fold
 
 __all__ = ["data_mesh", "default_mesh", "init_distributed",
+           "maybe_init_distributed", "process_info",
            "mesh_keyed_fold", "mesh_global_sum",
-           "mesh_blob_exchange", "mesh_shuffle_blocks"]
+           "mesh_blob_exchange", "mesh_shuffle_blocks",
+           "replan", "plan_exchange", "step_inflight_bytes"]

@@ -30,6 +30,13 @@ Design:
 There is no overflow/retry here (unlike the capacity-factor scheme in
 :func:`.shuffle.mesh_keyed_fold`): the host packs exact sizes, so the buffer
 always fits by construction.
+
+- **Budget**: one window is never one collective.  The planner
+  (:mod:`.replan`) decomposes each window into a schedule of chunked
+  all_to_all steps whose per-step in-flight bytes respect
+  ``settings.exchange_hbm_budget`` — blob slices round-robin across
+  steps and reassemble in order on the receive side, so peak device
+  memory is bounded by configuration while results stay byte-identical.
 """
 
 import functools
@@ -38,8 +45,8 @@ import pickle
 import numpy as np
 
 from .. import settings
+from . import replan
 from .mesh import mesh_size, shard_map as _shard_map
-from .shuffle import _pad_pow2
 
 
 @functools.lru_cache(maxsize=None)
@@ -80,38 +87,92 @@ def _build_exchange(mesh, axis, capacity, gather=False):
     return jax.jit(program)
 
 
-def mesh_blob_exchange(mesh, blobs):
-    """Move arbitrary byte blobs across the mesh.
+#: Shape of the LAST exchange this process ran (observability): steps,
+#: payload bytes, peak in-flight bytes (per the replan cost model),
+#: whether the budget clamped at the capacity floor, and per-device
+#: sent/received payload byte counts.  The runner folds these into its
+#: per-run ``stats()["mesh"]["exchange"]`` section; the multichip dryrun
+#: prints them per device.
+last_info = None
+
+
+def mesh_blob_exchange(mesh, blobs, budget=None):
+    """Move arbitrary byte blobs across the mesh, under an HBM budget.
 
     ``blobs``: {(src_device, dst_device): bytes}.  Returns the delivered
-    {(src_device, dst_device): bytes} — every blob crossed the collective
-    (row ``s*D+d`` of the send buffer lives on device s; the matching row of
+    {(src_device, dst_device): bytes} — every blob crossed a collective
+    (row ``s*D+d`` of a send buffer lives on device s; the matching row of
     the receive buffer lives on device d).
+
+    The transfer runs as a :mod:`.replan` schedule of chunked all_to_all
+    steps whose modeled in-flight bytes respect ``budget`` (default
+    ``settings.exchange_hbm_budget``); blob slices reassemble in piece
+    order, so the result is byte-identical to a single collective.  Each
+    step emits ``exchange`` spans for its pack (h2d staging), collective,
+    and unpack (d2h fetch) phases.
     """
-    D = mesh_size(mesh)
-    max_len = max((len(b) for b in blobs.values()), default=0)
-    capacity = _pad_pow2(max(1, max_len), floor=64)
-    buf = np.zeros((D * D, capacity), dtype=np.uint8)
-    lens = np.zeros(D * D, dtype=np.int32)
-    for (s, d), blob in blobs.items():
-        row = s * D + d
-        lens[row] = len(blob)
-        if blob:
-            buf[row, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
     import jax
 
-    prog = _build_exchange(mesh, settings.mesh_axis, capacity,
-                           gather=jax.process_count() > 1)
-    rb, rl = prog(buf, lens)
-    rb = np.asarray(rb)
-    rl = np.asarray(rl)
-    out = {}
+    from ..obs import trace as _trace
+
+    global last_info
+    D = mesh_size(mesh)
+    gather = jax.process_count() > 1
+    sched = replan.plan_exchange(
+        D, {sd: len(b) for sd, b in blobs.items()},
+        budget=budget, gather=gather)
+    sent = [0] * D
+    received = [0] * D
+    parts = {}
+    for i, step in enumerate(sched.steps):
+        buf = np.zeros((D * D, step.capacity), dtype=np.uint8)
+        lens = np.zeros(D * D, dtype=np.int32)
+        with _trace.span("exchange", "h2d:{}".format(i),
+                         step=i, capacity=int(step.capacity)):
+            for s, d, start, stop in step.cells:
+                row = s * D + d
+                n = stop - start
+                lens[row] = n
+                if n:
+                    buf[row, :n] = np.frombuffer(
+                        blobs[(s, d)], dtype=np.uint8, count=n,
+                        offset=start)
+                    sent[s] += n
+        prog = _build_exchange(mesh, settings.mesh_axis, step.capacity,
+                               gather=gather)
+        with _trace.span("exchange", "step:{}".format(i), step=i,
+                         bytes=int(step.payload_bytes()),
+                         capacity=int(step.capacity),
+                         inflight_bytes=int(step.inflight_bytes)):
+            rb, rl = prog(buf, lens)
+            rb.block_until_ready()
+        with _trace.span("exchange", "d2h:{}".format(i), step=i):
+            rb = np.asarray(rb)
+            rl = np.asarray(rl)
+            for s, d, _start, _stop in step.cells:
+                row = d * D + s  # device d's local row s = sent by s
+                n = int(rl[row])
+                if n:
+                    parts.setdefault((s, d), []).append(
+                        rb[row, :n].tobytes())
+                    received[d] += n
+    out = {sd: b"".join(ps) for sd, ps in parts.items()}
     for d in range(D):
-        for s in range(D):
-            row = d * D + s  # device d's local row s = what s sent to d
-            n = int(rl[row])
-            if n:
-                out[(s, d)] = rb[row, :n].tobytes()
+        if sent[d]:
+            sent_bytes_per_device[d] = (
+                sent_bytes_per_device.get(d, 0) + sent[d])
+        if received[d]:
+            received_bytes_per_device[d] = (
+                received_bytes_per_device.get(d, 0) + received[d])
+    last_info = {
+        "steps": sched.n_steps,
+        "bytes": sched.total_bytes,
+        "peak_inflight_bytes": sched.peak_inflight_bytes,
+        "budget": sched.budget,
+        "clamped": sched.clamped,
+        "sent_per_device": sent,
+        "received_per_device": received,
+    }
     return out
 
 
@@ -133,6 +194,14 @@ def _unpack_group(blob):
 #: Process-level cumulative stats (observability; tests assert engagement).
 total_exchanges = 0
 total_bytes = 0
+total_steps = 0
+peak_inflight_bytes = 0  # high-water mark across every schedule run
+#: Cumulative payload bytes by device index (process-level): what each
+#: source device put on the wire and each destination drained — the
+#: per-device view the multichip dryrun reports instead of only the
+#: aggregate total.
+sent_bytes_per_device = {}
+received_bytes_per_device = {}
 
 
 def mesh_shuffle_blocks(mesh, routed):
@@ -149,7 +218,7 @@ def mesh_shuffle_blocks(mesh, routed):
     """
     from ..obs import trace as _trace
 
-    global total_exchanges, total_bytes
+    global total_exchanges, total_bytes, total_steps, peak_inflight_bytes
     D = mesh_size(mesh)
     groups = {}
     for seq, src, pid, blk in routed:
@@ -161,6 +230,10 @@ def mesh_shuffle_blocks(mesh, routed):
         recv = mesh_blob_exchange(mesh, blobs)
     total_exchanges += 1
     total_bytes += moved
+    if last_info is not None:
+        total_steps += last_info["steps"]
+        peak_inflight_bytes = max(peak_inflight_bytes,
+                                  last_info["peak_inflight_bytes"])
     out = []
     for (s, d), blob in recv.items():
         for seq, pid, blk in _unpack_group(blob):

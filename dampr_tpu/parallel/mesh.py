@@ -40,21 +40,32 @@ def init_distributed(coordinator_address=None, num_processes=None,
     slice and DCN across hosts — no other code changes (the mesh abstraction
     is host-count-agnostic by design, SURVEY §7 hard part 5).
 
-    Arguments default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
-    JAX_PROCESS_ID environment variables (read here — jax itself only reads
-    the coordinator address) or to full auto-detection on managed clusters
-    (cloud TPU pods, Slurm, k8s).  Call once per process before any jax use.
+    Arguments default to the DAMPR_TPU_COORDINATOR / DAMPR_TPU_NUM_PROCESSES
+    / DAMPR_TPU_PROCESS_ID environment variables (the engine's own spelling,
+    set per rank by launchers and the multi-process benches), falling back
+    to JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID (read
+    here — jax itself only reads the coordinator address) or to full
+    auto-detection on managed clusters (cloud TPU pods, Slurm, k8s).  Call
+    once per process before any jax use.
     """
     import os
 
     import jax
 
     if coordinator_address is None:
-        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS") or None
-    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
-        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
-        process_id = int(os.environ["JAX_PROCESS_ID"])
+        coordinator_address = (os.environ.get("DAMPR_TPU_COORDINATOR")
+                               or os.environ.get("JAX_COORDINATOR_ADDRESS")
+                               or None)
+    if num_processes is None:
+        raw = (os.environ.get("DAMPR_TPU_NUM_PROCESSES")
+               or os.environ.get("JAX_NUM_PROCESSES"))
+        if raw:
+            num_processes = int(raw)
+    if process_id is None:
+        raw = (os.environ.get("DAMPR_TPU_PROCESS_ID")
+               or os.environ.get("JAX_PROCESS_ID"))
+        if raw is not None and raw != "":
+            process_id = int(raw)
 
     kwargs = {}
     if coordinator_address is not None:
@@ -85,6 +96,47 @@ def init_distributed(coordinator_address=None, num_processes=None,
     except Exception:  # noqa: BLE001 - best-effort; initialize() decides
         pass
     jax.distributed.initialize(**kwargs)
+    global _initialized
+    _initialized = True
+
+
+_initialized = False
+
+
+def maybe_init_distributed():
+    """Join a multi-process deployment IF the environment configures one
+    (``DAMPR_TPU_COORDINATOR`` / ``JAX_COORDINATOR_ADDRESS`` set), else
+    no-op.  Idempotent — safe to call from every CLI entry point and
+    bench main, so any dampr_tpu process dropped onto a pod rank with the
+    coordinator env wired joins the process group before its first jax
+    use with zero code changes (the pjit-spans-processes property,
+    SNIPPETS [1]).  Returns True when this call performed the init."""
+    import os
+
+    if _initialized:
+        return False
+    if not (os.environ.get("DAMPR_TPU_COORDINATOR")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        return False
+    init_distributed()
+    return True
+
+
+def process_info():
+    """This process's view of the deployment, for reports and logs:
+    process id/count, local vs global device counts, and whether the
+    backend actually spans processes.  Touches jax (initializes the
+    backend if needed) — call it for reporting, not gating; gates use
+    ``settings.device_count_for_auto`` which never forces an init."""
+    import jax
+
+    return {
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "multiprocess": jax.process_count() > 1,
+    }
 
 
 def shard_map(f, mesh, in_specs, out_specs, **kwargs):

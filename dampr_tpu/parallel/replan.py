@@ -1,0 +1,157 @@
+"""HBM-bounded collective redistribution: plan one large all_to_all as a
+schedule of chunked collectives.
+
+The naive byte exchange (:func:`.exchange.mesh_blob_exchange`) sizes its
+``[D*D, C]`` buffer by the LARGEST blob in the window: one fat
+(src, dst) pair amplifies to a ``D*D``-row buffer of that blob's pow2
+bucket on every device, and the gather-replicated multi-process variant
+triples it.  On a real pod that peak is the number that OOMs — the
+shuffle's working set must be bounded by a *budget*, not by the data.
+
+This module is the planning half of the fix, after "Memory-efficient
+array redistribution" (arXiv 2112.01075): instead of emitting one
+collective sized by the data, decompose the redistribution into a
+*schedule* of steps whose per-step in-flight bytes provably respect
+``settings.exchange_hbm_budget``:
+
+1. From the budget, derive the largest pow2 cell capacity ``C_max`` whose
+   step buffers fit (:func:`max_capacity_for`, via the deterministic cost
+   model :func:`step_inflight_bytes`).
+2. Slice every blob into pieces of at most ``C_max`` bytes.
+3. Round-robin the pieces: step ``i`` carries piece ``i`` of every
+   (src, dst) pair — each step is one well-formed ``[D*D, C_i]``
+   all_to_all with ``C_i <= C_max`` (tail steps shrink to their own
+   largest piece, so short schedules don't pay the full bucket).
+
+The executor (:func:`.exchange.mesh_blob_exchange`) walks the schedule,
+reusing one compiled program per (mesh, capacity) bucket, and reassembles
+pieces in order on the receive side.  Everything here is pure host-side
+planning — no jax imports — so the schedule invariants are cheaply
+property-testable (tests/test_multiprocess.py).
+"""
+
+from .. import settings
+
+#: Smallest cell capacity a step may use: below this the int32 length row
+#: and dispatch overhead dominate the payload.  A budget too small for
+#: even this floor is *clamped* (recorded on the schedule), never honored
+#: by silently dropping data.
+MIN_CAPACITY = 64
+
+#: Length-row bytes per cell (int32 valid-length lane riding each step).
+_LEN_BYTES = 4
+
+
+def _pow2(n, floor=MIN_CAPACITY):
+    return max(floor, 1 << max(0, (int(n) - 1).bit_length()))
+
+
+def _pow2_floor(n, floor=MIN_CAPACITY):
+    """Largest pow2 at or UNDER n (an upper bound must never round up:
+    the explicit chunk knob exists for memory-pressured operators, so a
+    piece may not exceed what they asked for)."""
+    return max(floor, 1 << max(0, int(n).bit_length() - 1))
+
+
+def step_inflight_bytes(n_dev, capacity, gather=False):
+    """Deterministic peak-bytes model for one exchange step at cell
+    capacity ``capacity``: the send buffer and the delivered buffer are
+    both live across the collective (``2 *``), and the multi-process
+    gather variant replicates the delivered buffer once more so every
+    host can read the full result (``3 *``).  Each cell also carries an
+    int32 length lane.  This is the number schedules are planned and
+    reported against (``peak_inflight_bytes``)."""
+    copies = 3 if gather else 2
+    cells = n_dev * n_dev
+    return copies * cells * (int(capacity) + _LEN_BYTES)
+
+
+def max_capacity_for(n_dev, budget, gather=False):
+    """The largest pow2 cell capacity whose step fits ``budget`` under
+    :func:`step_inflight_bytes`.  Returns ``(capacity, clamped)`` —
+    ``clamped`` is True when even :data:`MIN_CAPACITY` exceeds the budget
+    (the schedule still runs at the floor; refusing would drop data)."""
+    cap = MIN_CAPACITY
+    if step_inflight_bytes(n_dev, cap, gather) > budget:
+        return cap, True
+    while step_inflight_bytes(n_dev, cap * 2, gather) <= budget:
+        cap *= 2
+    return cap, False
+
+
+class ExchangeStep(object):
+    """One collective step: ``cells`` is ``[(src, dst, start, stop)]`` —
+    the byte slice of blob ``(src, dst)`` this step carries — and
+    ``capacity`` the pow2 cell bucket the step's program compiles at."""
+
+    __slots__ = ("cells", "capacity", "inflight_bytes")
+
+    def __init__(self, cells, capacity, inflight_bytes):
+        self.cells = cells
+        self.capacity = capacity
+        self.inflight_bytes = inflight_bytes
+
+    def payload_bytes(self):
+        return sum(stop - start for _s, _d, start, stop in self.cells)
+
+
+class ExchangeSchedule(object):
+    """The planned step sequence plus the invariants callers report:
+    ``peak_inflight_bytes`` (max of the per-step model) and ``clamped``
+    (budget below the capacity floor — the only case where
+    ``peak_inflight_bytes > budget``)."""
+
+    def __init__(self, n_dev, steps, budget, gather, clamped):
+        self.n_dev = n_dev
+        self.steps = steps
+        self.budget = budget
+        self.gather = gather
+        self.clamped = clamped
+        self.total_bytes = sum(s.payload_bytes() for s in steps)
+        self.peak_inflight_bytes = max(
+            (s.inflight_bytes for s in steps), default=0)
+
+    @property
+    def n_steps(self):
+        return len(self.steps)
+
+
+def plan_exchange(n_dev, sizes, budget=None, gather=False,
+                  chunk_bytes=None):
+    """Plan a budget-bounded exchange of ``sizes`` ({(src, dst): nbytes})
+    across an ``n_dev`` mesh.
+
+    ``budget`` defaults to ``settings.exchange_hbm_budget``;
+    ``chunk_bytes`` (default ``settings.exchange_chunk_bytes``, 0 = off)
+    additionally caps the per-piece size below what the budget allows —
+    the explicit chunk-size knob the doctor playbook points at when a
+    device is memory-pressured beyond what the budget models.
+    """
+    if budget is None:
+        budget = settings.exchange_hbm_budget
+    if chunk_bytes is None:
+        chunk_bytes = settings.exchange_chunk_bytes
+    cap, clamped = max_capacity_for(n_dev, budget, gather)
+    if chunk_bytes:
+        cap = min(cap, _pow2_floor(chunk_bytes))
+
+    # Round-robin piece assignment: piece i of every blob rides step i.
+    pairs = sorted(sizes.items())
+    n_steps = max((-(-n // cap) if n else 1 for _sd, n in pairs),
+                  default=0)
+    steps = []
+    for i in range(n_steps):
+        cells = []
+        largest = 0
+        for (s, d), n in pairs:
+            start = i * cap
+            if start > 0 and start >= n:
+                continue  # this blob finished in an earlier step
+            stop = min(n, start + cap)
+            cells.append((s, d, start, stop))
+            largest = max(largest, stop - start)
+        capacity = min(cap, _pow2(max(1, largest)))
+        steps.append(ExchangeStep(
+            cells, capacity,
+            step_inflight_bytes(n_dev, capacity, gather)))
+    return ExchangeSchedule(n_dev, steps, budget, gather, clamped)

@@ -61,6 +61,7 @@ def empty_report(graph, enabled):
         "dead": [],
         "adaptive": {"applied": False, "reason": "disabled"},
         "lowering": lower.empty_section(False),
+        "shuffle": lower.empty_shuffle_section(False),
         "device_stages": 0,
         "seconds": 0.0,
     }
@@ -93,6 +94,11 @@ def apply_to_runner(runner, outputs):
     # each stage its execution target, stats history pinning tiny stages
     # to host.
     lower.apply(runner, outputs, report)
+    # Host-vs-mesh shuffle routing for the redistribution stages the
+    # lowering pass left on host: a plan-level choice (explicit settings
+    # win, auto decides from the history corpus) the runner's dispatch
+    # consults when it exchanges partitions.
+    lower.apply_shuffle(runner, report)
     # Shape records ride into stats.json so the NEXT run's cost layer can
     # match its plan against this run's measurements.
     report["stage_shapes"] = ir.stage_shapes(getattr(runner, "graph", graph))

@@ -99,6 +99,51 @@ def matched_history(run_name, graph):
     return hist
 
 
+def shuffle_choice(hist_stage, n_dev, n_partitions, mode=None):
+    """(target, reason) — route one redistribution stage's shuffle over
+    the ``host`` threadpool path or the ``mesh`` collective byte exchange
+    (:mod:`dampr_tpu.parallel.exchange`).
+
+    Explicit ``settings.mesh_exchange`` modes always win; ``auto`` decides
+    from the run-history corpus — a stage whose recorded shuffle input is
+    under ``settings.exchange_min_bytes`` keeps the host path (the D*D
+    window pack/unpack fixed cost dominates tiny exchanges), anything
+    larger (or unmeasured) rides the budgeted collective schedule.  The
+    reason string carries the evidence (bytes, record sizes, partition
+    counts) into ``explain()`` and the plan report.
+    """
+    if mode is None:
+        mode = settings.mesh_exchange
+    m = str(mode).lower()
+    if m in ("off", "0", "false") or not settings.use_device:
+        return "host", "settings.mesh_exchange={!r} pins the host " \
+            "shuffle".format(mode)
+    if m in ("on", "1", "true"):
+        return "mesh", "settings.mesh_exchange={!r} forces the " \
+            "collective exchange".format(mode)
+    if n_dev < 2:
+        return "host", "single visible device — nothing to exchange over"
+    st = hist_stage or {}
+    bytes_in = st.get("bytes_in")
+    if not bytes_in:
+        return "mesh", "{} devices visible, no shuffle history — the " \
+            "budgeted collective engages by availability".format(n_dev)
+    if bytes_in < settings.exchange_min_bytes:
+        return "host", (
+            "history: {} B shuffle input < exchange_min_bytes={} — the "
+            "D*D collective window pack/unpack overhead dominates; host "
+            "shuffle is cheaper".format(
+                bytes_in, settings.exchange_min_bytes))
+    recs = st.get("records_in") or st.get("records_out") or 0
+    rec_bytes = (bytes_in / float(recs)) if recs else None
+    detail = "~{:.0f} B/record, ".format(rec_bytes) if rec_bytes else ""
+    return "mesh", (
+        "history: {} B shuffle input across {} partitions on {} devices "
+        "({}windowed under exchange_hbm_budget={})".format(
+            bytes_in, n_partitions, n_dev, detail,
+            settings.exchange_hbm_budget))
+
+
 def _clamped_partitions(reduce_bytes):
     want = max(1, -(-int(reduce_bytes) // settings.plan_partition_bytes))
     floor = max(4, min(settings.max_processes, settings.partitions))

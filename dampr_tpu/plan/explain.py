@@ -37,6 +37,7 @@ def explain_text(graph, outputs, name=None):
         lines.append("optimizer OFF (settings.optimize / "
                      "DAMPR_TPU_OPTIMIZE=0): the plan above executes as-is")
         lines.extend(_target_lines(graph, name, outputs))
+        lines.extend(_shuffle_lines(graph, name, outputs))
         return "\n".join(lines)
     optimized, report = passes.optimize(graph, outputs)
     lines.append("== optimized plan ({} executed) =="
@@ -79,7 +80,43 @@ def explain_text(graph, outputs, name=None):
                         st.get("stage"), st.get("kind"),
                         st.get("records_out"), st.get("bytes_out")))
     lines.extend(_target_lines(optimized, name, outputs))
+    lines.extend(_shuffle_lines(optimized, name, outputs))
     return "\n".join(lines)
+
+
+def _shuffle_lines(graph, name, outputs=()):
+    """Host-vs-mesh routing for the plan's redistribution stages (the
+    cost layer's shuffle choice): which exchanges ride the HBM-budgeted
+    collective and why the rest keep the host shuffle.  Mirrors
+    ``lower.apply_shuffle`` exactly — device-lowered reduces are
+    reported as target=device, not as routed exchanges."""
+    mode = str(settings.mesh_exchange).lower()
+    if mode in ("off", "0", "false") or not settings.use_device:
+        return ["shuffle: mesh exchange off (settings.mesh_exchange={!r}; "
+                "every redistribution on the host shuffle)".format(
+                    settings.mesh_exchange)]
+    n_dev = (settings.device_count_for_auto()
+             if mode not in ("on", "1", "true") else 2)
+    device_sids = set()
+    if settings.lower_enabled():
+        hist_l = (cost.matched_history(name, graph)
+                  if name and not settings.lower_forced() else None)
+        device_sids = {
+            d["sid"] for d in lower.analyze(graph, hist_l, outputs)
+            if d["target"] == "device" and d["kind"] == "reduce"}
+    decisions = lower.shuffle_analyze(
+        graph, cost.matched_history(name, graph) if name else None,
+        n_dev, settings.partitions, device_sids)
+    if not decisions:
+        return []
+    n_mesh = sum(1 for d in decisions if d["target"] == "mesh")
+    lines = ["shuffle: {} of {} redistribution stage(s) routed over the "
+             "mesh exchange (hbm budget {})".format(
+                 n_mesh, len(decisions), settings.exchange_hbm_budget)]
+    for d in decisions:
+        lines.append("  s{}: {} shuffle -> {}  ({})".format(
+            d["sid"], d["kind"], d["target"], d["reason"]))
+    return lines
 
 
 def _target_lines(graph, name, outputs=()):

@@ -1,5 +1,5 @@
 """The device-lowering pass: assign each executed stage an explicit
-execution target (``host`` | ``device``).
+execution target (``host`` | ``device`` | ``mesh``).
 
 Runs after the rewrite passes (and on the literal graph when the
 optimizer is off — lowering is a placement decision, not a graph-shape
@@ -17,6 +17,19 @@ rewrite), inspecting each stage:
   fold (``sum``/``min``/``max``) — executed through the existing exact
   segment kernels, which still fall back per block when 32-bit lanes
   would truncate.
+- a redistribution stage that stays host — a general (non-associative)
+  reduce, a join, or a ``sort_by`` re-key map whose materialization is
+  read back key-sorted — additionally gets a **shuffle** target
+  (``mesh`` | ``host``) from :func:`cost.shuffle_choice`: explicit
+  ``settings.mesh_exchange`` modes win, auto mode decides from the
+  run-history corpus (shuffle input bytes, record sizes, partition
+  counts).  ``mesh`` routes the stage's redistribution through the
+  HBM-budgeted collective byte exchange
+  (:mod:`dampr_tpu.parallel.exchange`); results are byte-identical
+  either way.  The decision map rides the runner (``_shuffle_targets``
+  — a dispatch hint, deliberately NOT stage options, so resume/cache
+  fingerprints never depend on accumulated history) and lands in the
+  plan report's ``shuffle`` section.
 
 Placement is stats-driven (the tf.data-service argument, arXiv
 2210.14826): a prior run's history showing a stage emitted fewer than
@@ -176,6 +189,107 @@ def analyze(graph, history=None, outputs=()):
 
 def empty_section(enabled):
     return {"enabled": enabled, "targets": [], "device_stages": 0}
+
+
+def empty_shuffle_section(enabled):
+    return {"enabled": enabled, "targets": [], "mesh_stages": 0}
+
+
+def _is_sort_stage(stage):
+    """A GMap whose chain re-keys for a global sort (``sort_by``'s Rekey
+    op): its materialization is read back key-sorted, and the sorted
+    read's range redistribution is the shuffle being routed."""
+    if not isinstance(stage, GMap):
+        return False
+    return any(isinstance(p, base.Rekey)
+               for p in ir.flatten_mapper(stage.mapper))
+
+
+def shuffle_analyze(graph, history, n_dev, n_partitions,
+                    device_sids=()):
+    """Per-redistribution-stage shuffle decisions:
+    [{sid, kind, target, reason}].  Candidates are every GReduce (the
+    group_by/fold_by/join exchange) and every sort re-key GMap (the
+    sorted read's range exchange); device-lowered reduces are recorded
+    but not routed — their redistribution rides the collective fold
+    program, not the byte exchange."""
+    from . import cost
+
+    by_sid = {}
+    if history:
+        by_sid = {s.get("stage"): s for s in history.get("stages", [])}
+    decisions = []
+    for sid, stage in enumerate(graph.stages):
+        if isinstance(stage, GReduce):
+            kind = "reduce"
+        elif _is_sort_stage(stage):
+            # A Rekey chain feeding a reduce is a group_by's key-assign
+            # pass — its redistribution happens at the consuming reduce,
+            # which gets its own row.  Only reduce-free rekeys (sort_by
+            # materializations read back key-sorted) exchange at read.
+            if any(isinstance(c, GReduce) for c in graph.stages
+                   if stage.output in getattr(c, "inputs", ())):
+                continue
+            kind = "sort"
+        else:
+            continue
+        if sid in device_sids:
+            decisions.append({
+                "sid": sid, "kind": kind, "target": "device",
+                "reason": "device-lowered fold — redistribution rides "
+                          "the collective fold program, not the byte "
+                          "exchange"})
+            continue
+        target, reason = cost.shuffle_choice(
+            by_sid.get(sid), n_dev, n_partitions)
+        decisions.append({"sid": sid, "kind": kind, "target": target,
+                          "reason": reason})
+    return decisions
+
+
+def apply_shuffle(runner, report):
+    """Record host-vs-mesh shuffle decisions in ``report["shuffle"]`` and
+    ride the routing map on the runner (``runner._shuffle_targets``:
+    {sid: "mesh"|"host"}) for its target-aware redistribution dispatch.
+    Runs on BOTH optimizer legs and independently of device lowering —
+    the exchange is a redistribution transport, not a stage program.  The
+    map is a runtime dispatch hint, never stage options, so checkpoint /
+    cache fingerprints stay independent of accumulated history."""
+    graph = getattr(runner, "graph", None)
+    report["shuffle"] = empty_shuffle_section(False)
+    if graph is None or not hasattr(graph, "stages"):
+        return
+    mode = str(settings.mesh_exchange).lower()
+    section = report["shuffle"]
+    if mode in ("off", "0", "false") or not settings.use_device:
+        section["reason"] = (
+            "off (settings.mesh_exchange={!r}; every redistribution "
+            "stays on the host shuffle)".format(settings.mesh_exchange))
+        return
+    from . import cost
+
+    n_dev = (settings.device_count_for_auto()
+             if mode not in ("on", "1", "true") else None)
+    history = cost.matched_history(getattr(runner, "name", None), graph)
+    device_sids = {
+        d["sid"] for d in (report.get("lowering") or {}).get("targets", [])
+        if d["target"] == "device" and d["kind"] == "reduce"}
+    decisions = shuffle_analyze(
+        graph, history, n_dev if n_dev is not None else 2,
+        getattr(runner, "n_partitions", settings.partitions), device_sids)
+    section["enabled"] = True
+    section["targets"] = decisions
+    section["mesh_stages"] = sum(
+        1 for d in decisions if d["target"] == "mesh")
+    routing = {d["sid"]: d["target"] for d in decisions
+               if d["target"] in ("mesh", "host")}
+    try:
+        runner._shuffle_targets = routing
+    except AttributeError:
+        pass
+    if section["mesh_stages"]:
+        log.info("plan: %d redistribution stage(s) routed over the mesh "
+                 "exchange", section["mesh_stages"])
 
 
 def apply(runner, outputs, report):

@@ -77,17 +77,28 @@ class _SinkOutput(object):
         return [SinkDataset(p) for p in self.paths]
 
 
-def _exchange_mesh_gate(budget):
+def _exchange_mesh_gate(budget, target=None):
     """Shared engage/window policy for every mesh byte-exchange user.
     Returns (mesh, D, window_bytes) or None when the path is off or only
-    one device is visible.  The window bound keeps the worst-case send
-    buffer (D*D rows of one blob's pow2 bucket) a fraction of the budget."""
+    one device is visible.  The window bound keeps the host-side pack
+    working set a fraction of the run budget (the DEVICE-side bound is
+    separate: the exchange itself runs a replan schedule under
+    ``settings.exchange_hbm_budget``).
+
+    ``target`` is the plan layer's per-stage shuffle choice
+    (``"mesh"``/``"host"``, from the cost model over the run-history
+    corpus): ``"host"`` declines the mesh path in auto mode, ``"mesh"``
+    engages it even where the auto device-count heuristic would not.
+    Explicit ``settings.mesh_exchange`` modes always win — the plan's
+    choice was made under the same mode, so only auto runs ever diverge."""
     mode = str(settings.mesh_exchange).lower()
     if mode in ("off", "0", "false") or not settings.use_device:
         return None
-    if (mode not in ("on", "1", "true")
-            and settings.device_count_for_auto() < 2):
-        return None
+    if mode not in ("on", "1", "true"):
+        if target == "host":
+            return None
+        if target != "mesh" and settings.device_count_for_auto() < 2:
+            return None
     from .parallel.mesh import data_mesh, mesh_size
 
     mesh = data_mesh()
@@ -424,7 +435,8 @@ class OutputDataset(Dataset):
         if self._range_cache is None:
             budget = (self.store.budget if self.store is not None
                       else settings.max_memory_per_stage)
-            gate = _exchange_mesh_gate(budget)
+            gate = _exchange_mesh_gate(
+                budget, getattr(self.pset, "shuffle_target", None))
             if gate is None:
                 return None
             mesh, D, window = gate
@@ -682,7 +694,7 @@ class StageStats(object):
     __slots__ = ("stage_id", "kind", "n_jobs", "records_in", "records_out",
                  "bytes_in", "bytes_out", "spill_count", "spill_bytes",
                  "merge_gens", "merge_gen_bytes", "retries", "seconds",
-                 "target")
+                 "target", "shuffle_target")
 
     def __init__(self, stage_id, kind):
         self.stage_id = stage_id
@@ -691,6 +703,10 @@ class StageStats(object):
         # "device"); device map stages ran the jitted tokenize+hash+fold
         # programs, device reduces the segment kernels.
         self.target = "host"
+        # Host-vs-mesh shuffle routing the plan's cost layer chose for
+        # this stage's redistribution (None = not a redistribution stage,
+        # or routing off).
+        self.shuffle_target = None
         self.n_jobs = 0
         self.records_in = 0
         self.records_out = 0
@@ -715,6 +731,7 @@ class StageStats(object):
                 "merge_gens": self.merge_gens,
                 "merge_gen_bytes": self.merge_gen_bytes,
                 "retries": self.retries,
+                "shuffle_target": self.shuffle_target,
                 "seconds": round(self.seconds, 4)}
 
 
@@ -742,6 +759,12 @@ class MTRunner(object):
         self.mesh_folds = 0  # reduces executed via the mesh collective path
         self.mesh_exchanges = 0  # general shuffles routed over all_to_all
         self.mesh_exchange_bytes = 0  # payload bytes that crossed the mesh
+        self.mesh_exchange_steps = 0  # chunked collective steps executed
+        self.mesh_exchange_peak_inflight = 0  # modeled per-step high-water
+        # Host-vs-mesh shuffle routing per stage id, ridden here by the
+        # plan layer (plan.lower.apply_shuffle) — a dispatch hint, not
+        # stage options, so fingerprints never depend on history.
+        self._shuffle_targets = {}
         self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
         self.retries_total = 0  # transient-failure job re-executions
         self._retry_lock = threading.Lock()
@@ -1813,16 +1836,18 @@ class MTRunner(object):
                  nrec, len(jax.devices()))
         return pset, nrec, 1
 
-    def _mesh_exchange_entries(self, entries):
+    def _mesh_exchange_entries(self, entries, target=None):
         """The general shuffle on the mesh (the reference's universal
         DefaultShuffler — base.py:416-433 — as a collective): every input
-        partition's blocks cross a fixed-shape ``all_to_all`` byte exchange,
-        streamed in windows bounded by the run budget, with partition pid
-        landing on device pid % D.  Joins stay co-partitioned because both
-        inputs route identically.  Returns the exchanged PartitionSets (new
-        refs registered against the store), or None when the mesh path is
-        disabled or only one device is visible."""
-        gate = _exchange_mesh_gate(self.store.budget)
+        partition's blocks cross a budget-scheduled ``all_to_all`` byte
+        exchange, streamed in windows bounded by the run budget, with
+        partition pid landing on device pid % D.  Joins stay co-partitioned
+        because both inputs route identically.  ``target`` is the plan
+        layer's shuffle choice for this stage (see ``_exchange_mesh_gate``).
+        Returns the exchanged PartitionSets (new refs registered against
+        the store), or None when the mesh path is disabled or only one
+        device is visible."""
+        gate = _exchange_mesh_gate(self.store.budget, target)
         if gate is None:
             return None
         mesh, D, window = gate
@@ -1848,6 +1873,11 @@ class MTRunner(object):
                 for pid, blk in received:
                     out.add(pid, self.store.register(blk))
                 self.mesh_exchange_bytes += moved
+                if px.last_info is not None:
+                    self.mesh_exchange_steps += px.last_info["steps"]
+                    self.mesh_exchange_peak_inflight = max(
+                        self.mesh_exchange_peak_inflight,
+                        px.last_info["peak_inflight_bytes"])
                 ran_exchange = True
                 batch, batch_bytes = [], 0
 
@@ -1961,7 +1991,8 @@ class MTRunner(object):
         fast = self._tiny_assoc_reduce(stage, entries)
         if fast is not None:
             return fast
-        exchanged = self._mesh_exchange_entries(entries)
+        exchanged = self._mesh_exchange_entries(
+            entries, target=self._shuffle_targets.get(stage_id))
         if exchanged is not None:
             entries = exchanged
         P = self.n_partitions
@@ -2450,6 +2481,19 @@ class MTRunner(object):
                 "folds": self.mesh_folds,
                 "exchanges": self.mesh_exchanges,
                 "exchange_bytes": self.mesh_exchange_bytes,
+                # The chunked-collective shape of this run's exchanges:
+                # schedule steps executed, the modeled per-step in-flight
+                # high-water mark (parallel.replan.step_inflight_bytes),
+                # and the budget it was planned under.  mesh_stages is
+                # how many redistribution stages the plan routed here.
+                "exchange": {
+                    "bytes": self.mesh_exchange_bytes,
+                    "steps": self.mesh_exchange_steps,
+                    "peak_inflight_bytes": self.mesh_exchange_peak_inflight,
+                    "hbm_budget": settings.exchange_hbm_budget,
+                    "mesh_stages": ((self.plan_report or {}).get("shuffle")
+                                    or {}).get("mesh_stages", 0),
+                },
             },
             # Device execution: run-wide device counters — device_fraction
             # is thread-seconds inside ANY jitted kernel (lowered programs,
@@ -2769,8 +2813,15 @@ class MTRunner(object):
                     self.store, sid, stage_fps[sid], result, nrec)
                 if _resume.is_volatile(stage_fps[sid]):
                     volatile_sources.add(stage.output)
+            # Ride the plan's shuffle choice on the stage's materialized
+            # partitions: lazily-read sorted outputs (sort_by) decide
+            # host-vs-mesh range redistribution at read time, after the
+            # stage walk is gone.
+            if isinstance(result, storage.PartitionSet):
+                result.shuffle_target = self._shuffle_targets.get(sid)
             st = StageStats(sid, kind)
             st.target = (stage.options or {}).get("exec_target", "host")
+            st.shuffle_target = self._shuffle_targets.get(sid)
             st.n_jobs = njobs
             st.records_out = nrec
             st.seconds = time.time() - t0

@@ -214,6 +214,32 @@ mesh_fold = os.environ.get("DAMPR_TPU_MESH_FOLD", "auto")
 #: takes precedence where it applies.
 mesh_exchange = os.environ.get("DAMPR_TPU_MESH_EXCHANGE", "auto")
 
+#: Peak in-flight device bytes one exchange collective step may occupy
+#: (send + delivered buffers, tripled by the multi-process gather
+#: replication — the deterministic model in
+#: :func:`dampr_tpu.parallel.replan.step_inflight_bytes`).  The byte
+#: exchange decomposes every window into a schedule of chunked
+#: all_to_all steps that each fit this budget, so the shuffle's device
+#: working set is bounded by configuration, never by the data (the
+#: memory-efficient redistribution recipe, arXiv 2112.01075).
+exchange_hbm_budget = int(os.environ.get(
+    "DAMPR_TPU_EXCHANGE_HBM", str(64 * 1024 ** 2)))
+
+#: Optional explicit per-piece chunk cap (bytes) for the exchange
+#: schedule, below what the budget alone allows.  0 (default) derives the
+#: chunk size from ``exchange_hbm_budget``; set it when a device is
+#: memory-pressured beyond what the in-flight model captures (the doctor
+#: playbook's second exchange knob).
+exchange_chunk_bytes = int(os.environ.get("DAMPR_TPU_EXCHANGE_CHUNK", "0"))
+
+#: Cost-model floor for routing a redistribution over the mesh: in auto
+#: mode, a stage whose recorded shuffle input (run-history corpus) is
+#: under this many bytes keeps the host shuffle — collective windows pay
+#: D*D pack/unpack fixed costs that dominate tiny exchanges.  Explicit
+#: ``mesh_exchange="on"``/``"off"`` always wins over this heuristic.
+exchange_min_bytes = int(os.environ.get(
+    "DAMPR_TPU_EXCHANGE_MIN_BYTES", str(4 * 1024 ** 2)))
+
 #: Ingest readahead window (chunks): a background thread prefetches the next
 #: chunks' bytes (file IO + gzip inflate release the GIL) while the current
 #: chunk computes.  0 disables.  See inputs.Readahead.

@@ -1022,7 +1022,7 @@ class PartitionSet(object):
     the invariants that stage would have established."""
 
     __slots__ = ("parts", "n_partitions", "hash_routed", "hash_sorted",
-                 "key_sorted_runs")
+                 "key_sorted_runs", "shuffle_target")
 
     def __init__(self, n_partitions, hash_routed=False, hash_sorted=False,
                  key_sorted_runs=False):
@@ -1031,6 +1031,10 @@ class PartitionSet(object):
         self.hash_routed = hash_routed
         self.hash_sorted = hash_sorted
         self.key_sorted_runs = key_sorted_runs
+        # Host-vs-mesh routing the plan chose for the producing stage's
+        # redistribution (None = undecided): lazily-read sorted outputs
+        # consult it when they range-exchange at read time.
+        self.shuffle_target = None
 
     def add(self, pid, ref):
         self.parts.setdefault(pid, []).append(ref)

@@ -53,7 +53,10 @@ MODULES = [
     "dampr_tpu.ops.text",
     "dampr_tpu.ops.lower",
     "dampr_tpu.parallel",
+    "dampr_tpu.parallel.mesh",
     "dampr_tpu.parallel.shuffle",
+    "dampr_tpu.parallel.exchange",
+    "dampr_tpu.parallel.replan",
     "dampr_tpu.parallel.sgd",
     "dampr_tpu.native",
     "dampr_tpu.utils",

@@ -313,6 +313,20 @@ class TestDoctor:
                 assert hasattr(settings, knob), (verdict, knob)
                 assert why
 
+    def test_exchange_bound_verdict_maps_to_budget_knobs(self):
+        """An exchange-bound run (the `mesh` critpath verdict) must point
+        at the chunked-schedule knobs: the HBM budget first, then the
+        explicit chunk size (docs/parallel.md decision table)."""
+        knobs = [k for k, _e, _p, _w in doctor._PLAYBOOK["mesh"]]
+        assert knobs[0] == "exchange_hbm_budget"
+        assert "exchange_chunk_bytes" in knobs
+        sugs = doctor._suggestions_for("mesh", {}, run_settings={
+            "exchange_hbm_budget": 64 * 1024 ** 2})
+        by_knob = {s["setting"]: s for s in sugs}
+        assert by_knob["exchange_hbm_budget"]["suggested"] == 128 * 1024 ** 2
+        assert by_knob["exchange_hbm_budget"]["env"] == \
+            "DAMPR_TPU_EXCHANGE_HBM"
+
     def test_diagnose_traced_run_schema_valid(self, diagnosed, tmp_path):
         em = _tfidf_run(tmp_path, name="doc-run")
         em.delete()

@@ -2,6 +2,8 @@
 joins route their exchange through the all_to_all byte collective
 (parallel/exchange.py), matching the host path exactly."""
 
+import uuid
+
 import numpy as np
 import pytest
 
@@ -21,7 +23,12 @@ def exchange_on():
 
 
 def _run(pipe, **kw):
-    runner = MTRunner("mesh-exchange-test", pipe.pmer.graph, **kw)
+    # uuid-salted run name: the shuffle cost model reads the run-history
+    # corpus by (name, stage shapes) — a shared fixed name would let one
+    # test's tiny-run history pin a later same-shaped test's exchange to
+    # host (the auto-mode heuristic under exchange_min_bytes).
+    runner = MTRunner("mesh-exchange-test-%s" % uuid.uuid4().hex[:8],
+                      pipe.pmer.graph, **kw)
     out = runner.run([pipe.source])
     return out[0], runner
 

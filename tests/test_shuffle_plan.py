@@ -1,0 +1,170 @@
+"""The plan layer's host-vs-mesh shuffle routing: cost.shuffle_choice
+decisions, plan-report/explain() visibility, the runner's target-aware
+redistribution dispatch, and the stats()["mesh"]["exchange"] section."""
+
+import uuid
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.plan import cost, lower as plan_lower
+from dampr_tpu.runner import MTRunner, _exchange_mesh_gate
+
+
+@pytest.fixture(autouse=True)
+def shuffle_env():
+    old = (settings.partitions, settings.mesh_fold, settings.mesh_exchange,
+           settings.exchange_min_bytes)
+    settings.partitions = 8
+    settings.mesh_fold = "off"
+    settings.mesh_exchange = "auto"
+    yield
+    (settings.partitions, settings.mesh_fold, settings.mesh_exchange,
+     settings.exchange_min_bytes) = old
+
+
+def _salt(prefix):
+    return "%s-%s" % (prefix, uuid.uuid4().hex[:8])
+
+
+class TestShuffleChoice:
+    def test_explicit_modes_win(self):
+        t, r = cost.shuffle_choice(None, 8, 8, mode="off")
+        assert t == "host" and "mesh_exchange" in r
+        t, r = cost.shuffle_choice(
+            {"bytes_in": 10}, 8, 8, mode="on")
+        assert t == "mesh" and "forces" in r
+
+    def test_single_device_stays_host(self):
+        t, r = cost.shuffle_choice(None, 1, 8, mode="auto")
+        assert t == "host" and "single" in r
+
+    def test_no_history_defaults_mesh(self):
+        t, r = cost.shuffle_choice(None, 8, 8, mode="auto")
+        assert t == "mesh" and "no shuffle history" in r
+
+    def test_tiny_history_pins_host(self):
+        st = {"bytes_in": settings.exchange_min_bytes - 1}
+        t, r = cost.shuffle_choice(st, 8, 8, mode="auto")
+        assert t == "host" and "exchange_min_bytes" in r
+
+    def test_large_history_rides_mesh_with_evidence(self):
+        st = {"bytes_in": 64 * 1024 ** 2, "records_in": 1 << 20}
+        t, r = cost.shuffle_choice(st, 8, 32, mode="auto")
+        assert t == "mesh"
+        # the reason carries the evidence: bytes, record size, partitions
+        assert "B/record" in r and "32 partitions" in r
+        assert str(settings.exchange_hbm_budget) in r
+
+
+class TestGateTargets:
+    def test_host_target_declines_in_auto(self):
+        assert _exchange_mesh_gate(1 << 20, target="host") is None
+
+    def test_mesh_target_engages(self):
+        assert _exchange_mesh_gate(1 << 20, target="mesh") is not None
+
+    def test_explicit_off_beats_mesh_target(self):
+        settings.mesh_exchange = "off"
+        assert _exchange_mesh_gate(1 << 20, target="mesh") is None
+
+
+class TestPlanReportAndDispatch:
+    def _pipe(self, n=3000):
+        return (Dampr.memory([(i % 7, i) for i in range(n)], partitions=8)
+                .group_by(lambda x: x[0])
+                .reduce(lambda k, vs: len(list(vs))))
+
+    def test_report_carries_decisions_and_runner_map(self):
+        pipe = self._pipe()
+        runner = MTRunner(_salt("shufplan"), pipe.pmer.graph)
+        runner.run([pipe.source])
+        rep = runner.plan_report["shuffle"]
+        assert rep["enabled"] is True
+        reduce_rows = [d for d in rep["targets"] if d["kind"] == "reduce"]
+        assert reduce_rows and all(d["reason"] for d in reduce_rows)
+        assert rep["mesh_stages"] >= 1
+        assert set(runner._shuffle_targets.values()) <= {"mesh", "host"}
+
+    def test_history_pins_second_run_to_host(self):
+        """End to end: run 1 (no history) exchanges over the mesh; run 2
+        under the same name sees the corpus record a tiny shuffle and
+        keeps the host path — the cost model's call, visible in the
+        report with the evidence."""
+        name = _salt("shufpin")
+        pipe = self._pipe()
+        r1 = MTRunner(name, pipe.pmer.graph)
+        out1 = sorted(r1.run([pipe.source])[0].read())
+        assert r1.mesh_exchanges >= 1
+        pipe2 = self._pipe()
+        r2 = MTRunner(name, pipe2.pmer.graph)
+        out2 = sorted(r2.run([pipe2.source])[0].read())
+        assert out2 == out1  # byte-identical either way
+        rows = [d for d in r2.plan_report["shuffle"]["targets"]
+                if d["kind"] == "reduce"]
+        assert rows and rows[0]["target"] == "host"
+        assert "exchange_min_bytes" in rows[0]["reason"]
+        assert r2.mesh_exchanges == 0
+
+    def test_forced_on_ignores_tiny_history(self):
+        settings.mesh_exchange = "on"
+        name = _salt("shufforce")
+        for _ in range(2):
+            pipe = self._pipe()
+            r = MTRunner(name, pipe.pmer.graph)
+            r.run([pipe.source])
+            assert r.mesh_exchanges >= 1
+
+    def test_stats_exchange_section_and_stage_field(self):
+        pipe = self._pipe()
+        runner = MTRunner(_salt("shufstats"), pipe.pmer.graph)
+        out = runner.run([pipe.source])
+        del out
+        mesh = runner.run_summary["mesh"]
+        ex = mesh["exchange"]
+        assert ex["bytes"] == mesh["exchange_bytes"] > 0
+        assert ex["steps"] >= 1
+        assert 0 < ex["peak_inflight_bytes"] <= ex["hbm_budget"]
+        assert ex["mesh_stages"] >= 1
+        stages = [st.as_dict() for st in runner.stats]
+        assert any(st["shuffle_target"] == "mesh" for st in stages
+                   if st["kind"] == "reduce")
+
+    def test_device_lowered_reduce_recorded_not_routed(self):
+        """An assoc fold the lowering pass placed on device shows up in
+        the shuffle section as target=device (its redistribution rides
+        the collective fold, not the byte exchange)."""
+        old = settings.lower
+        settings.lower = "1"
+        try:
+            pipe = (Dampr.memory(list(range(5000)), partitions=8)
+                    .count(lambda x: x % 5))
+            runner = MTRunner(_salt("shufdev"), pipe.pmer.graph)
+            runner.run([pipe.source])
+            rows = runner.plan_report["shuffle"]["targets"]
+            dev = [d for d in rows if d["target"] == "device"]
+            assert dev and "collective fold" in dev[0]["reason"]
+            assert all(d["sid"] not in runner._shuffle_targets
+                       for d in dev)
+        finally:
+            settings.lower = old
+
+    def test_explain_renders_shuffle_lines(self):
+        text = self._pipe().explain()
+        assert "shuffle:" in text
+        assert "reduce shuffle -> mesh" in text
+        settings.mesh_exchange = "off"
+        text = self._pipe().explain()
+        assert "mesh exchange off" in text
+
+    def test_sort_stage_classified_and_hinted(self):
+        nums = [((i * 7919) % 10007) for i in range(20000)]
+        pipe = Dampr.memory(nums, partitions=8).sort_by(lambda x: x)
+        runner = MTRunner(_salt("shufsort"), pipe.pmer.graph,
+                          memory_budget=1 << 16)
+        out = runner.run([pipe.source])[0]
+        rows = [d for d in runner.plan_report["shuffle"]["targets"]
+                if d["kind"] == "sort"]
+        assert rows and rows[0]["target"] == "mesh"
+        assert out.pset.shuffle_target == "mesh"
+        assert [v for _k, v in out.read()] == sorted(nums)
