@@ -91,6 +91,97 @@ def _bench_exchange(mesh, args):
     }
 
 
+def _obs_export(run_name, tracer, wall_start, wall, rec):
+    """Per-rank artifact export for a traced bench run: trace.json +
+    a minimal stats.json (schema dampr-tpu-stats/1) under the rank's
+    trace dir, carrying the exchange route matrix obs.fleet folds into
+    the rank x rank send/recv matrices."""
+    from dampr_tpu.obs import export as _export
+    from dampr_tpu.parallel import exchange as px
+
+    proc = _export.process_section()
+    tdir = _export.run_trace_dir(run_name)
+    os.makedirs(tdir, exist_ok=True)
+    trace_file = _export.write_trace(
+        tracer, os.path.join(tdir, _export.TRACE_FILE))
+    info = px.last_info or {}
+    summary = {
+        "schema": _export.STATS_SCHEMA,
+        "run": run_name,
+        "process": proc,
+        "started_at": round(wall_start, 3),
+        "wall_seconds": round(wall, 4),
+        "n_partitions": 0,
+        "stages": [],
+        # records_out stays 0: the exchange bench materializes no record
+        # stream (keyed_fold_records_per_s is a RATE and must not leak
+        # into a count field the fleet table renders).
+        "totals": {"records_out": 0,
+                   "bytes_out": rec.get("exchange_bytes", 0),
+                   "spill_bytes": 0},
+        "mesh": {
+            "folds": 0,
+            "exchanges": 1,
+            "exchange_bytes": rec.get("exchange_bytes", 0),
+            "exchange": {
+                "bytes": rec.get("exchange_bytes", 0),
+                "steps": info.get("steps", 0),
+                "peak_inflight_bytes": info.get("peak_inflight_bytes", 0),
+                "hbm_budget": info.get("budget", 0),
+                "sent_per_device": {
+                    str(k): v
+                    for k, v in sorted(px.sent_bytes_per_device.items())},
+                "received_per_device": {
+                    str(k): v for k, v in sorted(
+                        px.received_bytes_per_device.items())},
+                "routes": [[s, d, n] for (s, d), n in sorted(
+                    px.pair_bytes_per_route.items())],
+            },
+        },
+        "spans": tracer.span_summary(),
+        "trace_file": trace_file,
+    }
+    spath = os.path.join(tdir, _export.STATS_FILE)
+    summary["stats_file"] = spath
+    _export.write_stats(summary, spath)
+    return trace_file, spath
+
+
+def _run_traced(args, run_name="shuffle-bench"):
+    """Run the bench under a run-scoped tracer; rank 0 of a
+    multi-process deployment then merges the fleet timeline (bounded
+    wait for siblings) and reports the merged trace + fleet section in
+    its JSON line."""
+    import time as _time
+
+    from dampr_tpu import settings
+    from dampr_tpu.obs import trace as _trace
+
+    tracer = _trace.Tracer(run_name)
+    _trace.start(tracer)
+    wall_start = _time.time()
+    try:
+        rec = _run_single(args)
+    finally:
+        _trace.stop(tracer)
+    wall = _time.time() - wall_start
+    trace_file, stats_file = _obs_export(run_name, tracer, wall_start,
+                                         wall, rec)
+    rec["trace_file"] = trace_file
+    rec["stats_file"] = stats_file
+    from dampr_tpu.obs import export as _export
+
+    proc = _export.process_section()
+    if proc.get("num_processes", 1) > 1 and not proc.get("process_id"):
+        from dampr_tpu.obs import fleet as _fleet
+
+        section = _fleet.merge_run(run_name,
+                                   wait_ms=settings.fleet_wait_ms)
+        if section is not None:
+            rec["fleet"] = section
+    return rec
+
+
 def _run_single(args):
     import jax
 
@@ -217,11 +308,15 @@ def main():
     if args.cpu or "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
         jax.config.update("jax_platforms", "cpu")
 
+    from dampr_tpu import settings
     from dampr_tpu.parallel.mesh import maybe_init_distributed
 
     maybe_init_distributed()  # joins the --mproc deployment when spawned
 
-    rec = _run_single(args)
+    if settings.trace:
+        rec = _run_traced(args)
+    else:
+        rec = _run_single(args)
     if jax.process_index() == 0:
         print(json.dumps(rec))
 

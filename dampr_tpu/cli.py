@@ -13,9 +13,12 @@ canonical workloads run from an installed package without a repo checkout.
 - ``dampr-tpu-stats``  — pretty-print a completed run's ``stats.json``
   and locate its Perfetto-loadable trace (see ``settings.trace``);
   ``--series`` renders the sampled metric time series, ``--prom`` dumps
-  Prometheus text exposition, and a run directory containing a
-  ``crashdump.json`` (the flight recorder's death artifact) makes the
-  command exit 3 so scripts detect failed runs.
+  Prometheus text exposition, ``--fleet`` merges a multi-process run's
+  per-rank traces into one timeline and prints the fleet section
+  (per-rank totals, exchange matrices, skew/straggler), and a run
+  directory containing any rank's crashdump (``crashdump.json`` /
+  ``crashdump.rank<k>.json`` — the flight recorder's death artifacts)
+  makes the command exit 3 so scripts detect failed runs.
 - ``dampr-tpu-doctor`` — ranked bottleneck diagnosis for a completed run
   (critical-path verdicts + per-op profile + history corpus -> concrete
   settings suggestions); ``--diff A B`` compares two runs, ``--json``
@@ -149,13 +152,19 @@ def doctor():
 
 def _report_crashdump(dump):
     """Describe a flight-recorder crash dump on stderr (the non-zero
-    exit's why)."""
+    exit's why).  Rank-attributed: a fleet run's dump names which rank
+    died."""
     import json
 
     line = "CRASHED RUN: crashdump at {}".format(dump)
     try:
         with open(dump) as f:
-            crash = (json.load(f).get("otherData") or {}).get("crash") or {}
+            other = json.load(f).get("otherData") or {}
+        crash = other.get("crash") or {}
+        proc = other.get("process") or crash or {}
+        if (proc.get("num_processes") or 1) > 1:
+            line += "  [rank {}/{}]".format(proc.get("process_id", "?"),
+                                            proc.get("num_processes"))
         if crash.get("reason"):
             line += "  (reason: {}".format(crash["reason"])
             if crash.get("exception"):
@@ -185,23 +194,44 @@ def stats():
     ap.add_argument("--prom", action="store_true",
                     help="dump the run's metrics in Prometheus text "
                          "exposition format")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge a multi-process run's per-rank traces "
+                         "into one Perfetto timeline and print the fleet "
+                         "section (per-rank totals, exchange matrices, "
+                         "per-step skew, straggler)")
     args = ap.parse_args()
 
     from .obs import export, flightrec
 
     summary, path = export.load_stats(args.run)
-    dump = flightrec.locate_crashdump(args.run)
+    # Scan EVERY rank's crashdump: a clean rank 0 must not mask a killed
+    # sibling (exit 3 names each dead rank).
+    dumps = flightrec.locate_all_crashdumps(args.run)
+    dump = dumps[0] if dumps else None
     if summary is None:
         if dump is not None:
             # A run that died before stats landed still has its crash
             # timeline — surface it and fail the invocation.
-            _report_crashdump(dump)
+            for d in dumps:
+                _report_crashdump(d)
             raise SystemExit(3)
         print("no stats.json found for {!r} (searched under {}); traced "
               "runs write one — enable settings.trace / DAMPR_TPU_TRACE=1"
               .format(args.run, export.run_trace_dir(args.run)),
               file=sys.stderr)
         raise SystemExit(2)
+    if args.fleet and summary.get("fleet") is None:
+        # Post-hoc merge BEFORE any output mode renders: the run may
+        # predate the finalize-time merge, or rank artifacts may have
+        # landed after rank 0 finished — merging is idempotent, and
+        # --json must embed the section instead of appending text to a
+        # machine-readable stream.
+        from .obs import fleet
+
+        section = fleet.merge_run(os.path.dirname(path) if path
+                                  else args.run)
+        if section is not None:
+            summary["fleet"] = section
     if args.prom:
         from .obs import promtext
 
@@ -225,6 +255,11 @@ def stats():
         if run_verdict:
             print("bottleneck: {}  (run `dampr-tpu-doctor {}` for the "
                   "full diagnosis)".format(run_verdict, args.run))
+    if args.fleet and not args.json and not args.prom:
+        from .obs import fleet
+
+        print()
+        print(fleet.format_fleet(summary.get("fleet")))
     if args.series:
         tf = summary.get("trace_file")
         if not tf or not os.path.isfile(tf):
@@ -242,5 +277,6 @@ def stats():
             print()
             print(export.format_series(export.load_series(tf)))
     if dump is not None:
-        _report_crashdump(dump)
+        for d in dumps:
+            _report_crashdump(d)
         raise SystemExit(3)

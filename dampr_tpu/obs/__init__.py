@@ -123,9 +123,33 @@ wall / run wall — the plane measures its own cost).
   compares runs, ``--json`` emits the ``docs/doctor_schema.json``
   report.
 
+**The fleet plane** (multi-process runs — docs/parallel.md):
+
+- **rank-tagged telemetry**: every artifact carries a ``process``
+  block (``process_id``/``num_processes`` via ``mesh.rank_info()``);
+  rank 0 keeps the legacy ``<run>/trace/`` layout, rank k writes
+  ``<run>/trace/rank<k>/``, and a killed non-zero rank's crashdump
+  lands as ``crashdump.rank<k>.json`` (``dampr-tpu-stats`` scans every
+  rank's dump for its exit-3 detection);
+- **merged timeline** (:mod:`.fleet`): per-rank traces fold into one
+  Perfetto document (one process lane per rank) aligned on the
+  ``init_distributed`` barrier-timestamp handshake — no wall-clock
+  trust; ``stats()["fleet"]`` carries per-rank totals, the rank x rank
+  exchange send/recv matrices, and per-collective-step skew
+  (entry-spread over step wall), which names the ``straggler_rank``;
+- **straggler diagnosis**: :mod:`.critpath` gains the ``skew``
+  resource (injected post-merge via ``apply_skew``) and
+  ``dampr-tpu-doctor`` emits fleet verdicts mapping skew to concrete
+  knobs; ``dampr-tpu-stats --fleet`` renders (and idempotently
+  re-merges) the section;
+- **live metrics endpoint** (:mod:`.serve`, ``settings.metrics_port``):
+  a stdlib HTTP thread per rank serving ``/metrics`` (Prometheus text,
+  rank-labeled) and ``/healthz`` while the run is in flight — rank k
+  binds ``metrics_port + k``.
+
 The consolidated guide — schemas, Perfetto counter-track how-to,
 Prometheus scrape example, crashdump shape, the diagnosis taxonomy,
-the CI perf gate — is ``docs/observability.md``.
+the fleet layer, the CI perf gate — is ``docs/observability.md``.
 
 For a profiler-grade XLA kernel timeline (HLO names, TPU counters) use
 the existing escape hatch instead: ``settings.profile_dir`` wraps the

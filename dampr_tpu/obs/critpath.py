@@ -35,6 +35,9 @@ verdict          meaning
 ``transfer``     h2d/d2h movement (HBM tier puts/fetches, program drains)
 ``overlap-stall``  every live fold consumer blocked on its codec producer
 ``mesh``         collective folds/exchanges bound it
+``skew``         collective-entry spread: the fleet waited on a straggler
+                 rank (only measurable from the merged cross-rank
+                 timeline — see :mod:`.fleet` and :func:`apply_skew`)
 ``host-compute`` uninstrumented host work (opaque UDFs, Python glue)
 ===============  ============================================================
 
@@ -67,7 +70,7 @@ _RESOURCE_BY_CAT = {
 #: it, so productive resources win ties at equal fractions.
 _PRIORITY = ("device", "codec", "fold", "merge", "mesh", "spill-write",
              "transfer", "spill-queue", "io-read", "overlap-stall",
-             "checkpoint", "host-compute")
+             "skew", "checkpoint", "host-compute")
 
 _STAGE_NAME = re.compile(r"^s(\d+):")
 
@@ -246,6 +249,32 @@ def from_summary_only(summary):
                 "attributed_fraction": attributed,
                 "seconds": round(wall, 4)},
     }
+
+
+def apply_skew(section, fleet, wall):
+    """Inject the fleet's ``skew`` resource into a run-level critpath
+    section (in place) once the merged cross-rank timeline exists.
+
+    Skew is invisible to a single rank's span union — a rank blocked in
+    a collective waiting for a straggler shows up as ``mesh`` time.  The
+    fleet merge (:func:`dampr_tpu.obs.fleet.step_skew`) measures the
+    collective-entry spread directly, so here it becomes its own
+    resource fraction (sum of per-step spreads over run wall, clamped)
+    and may take the run verdict when it dominates.  Stage verdicts are
+    untouched: skew is a fleet-level phenomenon."""
+    skew = (fleet or {}).get("skew") or {}
+    sec = (skew.get("skew_seconds") or 0.0)
+    run = (section or {}).get("run")
+    if not run or sec <= 0 or wall <= 0:
+        return section
+    fractions = run.setdefault("fractions", {})
+    fractions["skew"] = round(min(1.0, sec / wall), 4)
+    verdict = max(fractions,
+                  key=lambda r: (fractions[r], -_PRIORITY.index(r)
+                                 if r in _PRIORITY else 0))
+    run["verdict"] = verdict
+    run["skew_seconds"] = round(sec, 4)
+    return section
 
 
 def from_run(run):

@@ -120,6 +120,22 @@ _PLAYBOOK = {
          "the stage — profile it (DAMPR_TPU_PROFILE=1) to see which op, "
          "and check worker-thread width"),
     ],
+    "skew": [
+        ("spill_read_prefetch", "DAMPR_TPU_SPILL_PREFETCH",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "the straggler rank arrives late at collective steps — deeper "
+         "frame readahead on that rank overlaps its decode with the "
+         "fleet's compute so it reaches the barrier with everyone else"),
+        ("partitions", "",
+         lambda cur: None,
+         "rebalance partitions: persistent per-rank lateness with a "
+         "lopsided exchange send/recv matrix means some ranks carry "
+         "more bytes per step than others"),
+        ("exchange_hbm_budget", "DAMPR_TPU_EXCHANGE_HBM",
+         lambda cur: max(64 * 1024 ** 2, int(cur or 0) * 2),
+         "fewer, larger collective steps amortize the per-step entry "
+         "spread when skew is jitter rather than a persistent straggler"),
+    ],
     "mesh": [
         ("exchange_hbm_budget", "DAMPR_TPU_EXCHANGE_HBM",
          lambda cur: max(64 * 1024 ** 2, int(cur or 0) * 2),
@@ -264,7 +280,11 @@ def diagnose(run):
             "run's artifacts (traced runs persist them — "
             "DAMPR_TPU_TRACE=1)".format(run))
     wall = summary.get("wall_seconds") or 0.0
-    hist = history.load(summary.get("run"))
+    # Rank-tagged corpus records (non-zero ranks of a fleet run) carry
+    # rank-local settings/timings — the diagnosis baseline is the
+    # run-level (rank-0) trail.
+    hist = [r for r in history.load(summary.get("run"))
+            if not r.get("rank")]
     run_settings = _run_settings(summary, hist)
     findings = []
 
@@ -365,6 +385,73 @@ def diagnose(run):
             }],
         })
 
+    # -- fleet verdicts (multi-process runs with a merged timeline) ----------
+    fleet = summary.get("fleet") or {}
+    fleet_report = None
+    if (fleet.get("num_processes") or 1) > 1:
+        skew = fleet.get("skew") or {}
+        straggler = skew.get("straggler_rank")
+        fleet_report = {
+            "num_processes": fleet.get("num_processes"),
+            "ranks": fleet.get("ranks"),
+            "missing_ranks": fleet.get("missing_ranks") or [],
+            "alignment": fleet.get("alignment"),
+            "straggler_rank": straggler,
+            "late_ratio": skew.get("late_ratio"),
+            "mean_step_skew_fraction": skew.get("mean_fraction"),
+            "max_step_skew_fraction": skew.get("max_fraction"),
+            "skew_seconds": skew.get("skew_seconds"),
+            "per_rank": [
+                {k: v for k, v in e.items() if v is not None}
+                for e in fleet.get("per_rank") or ()],
+        }
+        # Schema discipline: typed optional keys are omitted, not null.
+        fleet_report = {k: v for k, v in fleet_report.items()
+                        if v is not None}
+        sec = skew.get("skew_seconds") or 0.0
+        # A skew finding is worth ranking when the fleet measurably
+        # waited: spreads covering >=5% of wall, or any step where the
+        # entry spread dominated the step (a hard straggler signature).
+        if (wall > 0 and straggler is not None
+                and (sec / wall > 0.05
+                     or (skew.get("max_fraction") or 0.0) >= 0.5)):
+            straggler_verdict = None
+            for e in fleet.get("per_rank") or ():
+                if e.get("rank") == straggler:
+                    straggler_verdict = e.get("verdict")
+            evidence = ("rank {} enters collective steps {:.1f}x later "
+                        "than the fleet average (entry spread covered "
+                        "{:.0%} of step wall over {} step(s); the fleet "
+                        "waited {:.2f}s on it)".format(
+                            straggler, skew.get("late_ratio") or 1.0,
+                            skew.get("mean_fraction") or 0.0,
+                            len(skew.get("steps") or ()), sec))
+            if straggler_verdict and straggler_verdict not in (
+                    "idle", "host-compute"):
+                evidence += ("; that rank's own bottleneck is {} — fix "
+                             "it there first".format(straggler_verdict))
+            findings.append({
+                "stage": None,
+                "bottleneck": "skew",
+                "impact_seconds": round(min(sec, wall), 4),
+                "severity": _severity(min(sec, wall), wall),
+                "evidence": evidence,
+                "suggestions": _suggestions_for(
+                    "skew", summary, run_settings=run_settings),
+            })
+        for missing in fleet_report["missing_ranks"]:
+            findings.append({
+                "stage": None,
+                "bottleneck": "skew",
+                "impact_seconds": 0.0,
+                "severity": "high",
+                "evidence": "rank {} left no artifacts — it was killed "
+                            "or never finished (check its crashdump: "
+                            "crashdump.rank{}.json)".format(
+                                missing, missing),
+                "suggestions": [],
+            })
+
     findings.sort(key=lambda f: -(f.get("impact_seconds") or 0.0))
     for rank, f in enumerate(findings, 1):
         f["rank"] = rank
@@ -380,6 +467,8 @@ def diagnose(run):
         "history_entries": len(hist),
         "crashed": flightrec.locate_crashdump(run) is not None,
     }
+    if fleet_report is not None:
+        report["fleet"] = fleet_report
     return report
 
 
@@ -502,6 +591,24 @@ def format_report(report):
             "{:.2f}s".format(st["seconds"])
             if st.get("seconds") is not None else "-",
             st.get("verdict") or "?", top))
+    fl = report.get("fleet")
+    if fl:
+        line = "fleet: {} process(es)".format(fl.get("num_processes"))
+        if fl.get("missing_ranks"):
+            line += " · MISSING ranks {}".format(fl["missing_ranks"])
+        if fl.get("straggler_rank") is not None:
+            line += (" · straggler: rank {} ({:.1f}x late, mean step "
+                     "skew {:.0%})".format(
+                         fl["straggler_rank"], fl.get("late_ratio") or 1.0,
+                         fl.get("mean_step_skew_fraction") or 0.0))
+        add(line)
+        for e in fl.get("per_rank") or ():
+            add("  rank {:>2}: {:>8} wall · {} spill · verdict {}".format(
+                e.get("rank"),
+                "{:.2f}s".format(e["wall_seconds"])
+                if e.get("wall_seconds") is not None else "-",
+                "{:.1f}MB".format((e.get("spill_bytes") or 0) / 1e6),
+                e.get("verdict") or "?"))
     if not report.get("findings"):
         add("no findings: nothing instrumented dominates — this run "
             "looks healthy at the recorded granularity")

@@ -29,12 +29,50 @@ TRACE_FILE = "trace.json"
 STATS_FILE = "stats.json"
 
 
-def run_trace_dir(run_name):
+def run_trace_dir(run_name, rank=None):
     """Where a run's artifacts live.  Mirrors RunStore's scratch layout so
-    the trace sits next to the run's durable spill/checkpoint outputs."""
+    the trace sits next to the run's durable spill/checkpoint outputs.
+
+    Multi-process runs write PER-RANK artifacts: rank 0 keeps the legacy
+    ``<run>/trace/`` path (single-process layouts — and every tool that
+    reads them — are unchanged), non-zero ranks write under
+    ``<run>/trace/rank<k>/``.  ``rank=None`` resolves the calling
+    process's own rank via :func:`dampr_tpu.parallel.mesh.rank_info`
+    (env/process-group based — never forces a jax init)."""
     safe = run_name.replace("/", "_")
     root = settings.trace_dir or settings.scratch_root
-    return os.path.join(root, safe, "trace")
+    base = os.path.join(root, safe, "trace")
+    if rank is None:
+        from ..parallel.mesh import rank_info
+
+        rank = rank_info()[0]
+    if rank and rank > 0:
+        return os.path.join(base, "rank{}".format(int(rank)))
+    return base
+
+
+def process_section():
+    """The ``process`` block stamped into every artifact (stats.json,
+    trace otherData, crashdumps, history records): rank identity plus
+    the clock-handshake anchor the fleet merge aligns timelines with.
+    Once a process group is up (jax already initialized) the device
+    shape rides along — the authoritative device->rank mapping for the
+    fleet exchange matrices; before that the block stays jax-free."""
+    from ..parallel import mesh
+
+    pid, n = mesh.rank_info()
+    sec = {"process_id": pid, "num_processes": n}
+    if mesh._initialized:
+        try:
+            import jax
+
+            sec["global_devices"] = len(jax.devices())
+            sec["local_devices"] = len(jax.local_devices())
+        except Exception:
+            pass
+    if mesh.clock_sync is not None:
+        sec["clock"] = dict(mesh.clock_sync)
+    return sec
 
 
 def chrome_events(tracer):
@@ -100,6 +138,12 @@ def write_trace(tracer, path, metrics=None):
     events = chrome_events(tracer)
     if metrics is not None:
         events.extend(counter_events(metrics))
+    # Rank-tagged: the process block carries this rank's identity and —
+    # when the clock handshake ran — its epoch + barrier anchors, which
+    # is everything obs.fleet needs to place this file's events on the
+    # fleet-common timeline (epoch_perf + ts_seconds - barrier_perf).
+    proc = process_section()
+    proc["epoch_perf"] = tracer.epoch
     doc = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -107,6 +151,7 @@ def write_trace(tracer, path, metrics=None):
             "run": tracer.run,
             "wall_start": tracer.wall_start,
             "producer": "dampr_tpu.obs",
+            "process": proc,
         },
     }
     tmp = path + ".tmp"

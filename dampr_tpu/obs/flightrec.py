@@ -39,6 +39,20 @@ _active = None
 _lock = threading.Lock()
 
 
+def crashdump_filename(rank=None):
+    """Per-rank crashdump name: rank 0 keeps the legacy
+    ``crashdump.json``; a killed non-zero rank writes
+    ``crashdump.rank<k>.json`` so its death artifact never clobbers
+    rank 0's (and the filename alone names the dead rank)."""
+    if rank is None:
+        from ..parallel.mesh import rank_info
+
+        rank = rank_info()[0]
+    if rank and rank > 0:
+        return "crashdump.rank{}.json".format(int(rank))
+    return CRASHDUMP_FILE
+
+
 class FlightRecorder(object):
     """Bounded ring of recent observability events for one run.
 
@@ -133,6 +147,7 @@ class FlightRecorder(object):
 
         try:
             snapshot = list(self._ring)
+            proc = _export.process_section()
             crash = {
                 "reason": reason,
                 "events": len(snapshot),
@@ -150,12 +165,14 @@ class FlightRecorder(object):
                     "run": self.run,
                     "wall_start": self.wall_start,
                     "producer": "dampr_tpu.obs.flightrec",
+                    "process": proc,
                     "crash": crash,
                 },
             }
-            tdir = _export.run_trace_dir(self.run)
+            rank = proc.get("process_id", 0)
+            tdir = _export.run_trace_dir(self.run, rank=rank)
             os.makedirs(tdir, exist_ok=True)
-            path = os.path.join(tdir, CRASHDUMP_FILE)
+            path = os.path.join(tdir, crashdump_filename(rank))
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(doc, f)
@@ -206,26 +223,55 @@ def clear_stale(run_name):
 
     try:
         os.unlink(os.path.join(_export.run_trace_dir(run_name),
-                               CRASHDUMP_FILE))
+                               crashdump_filename()))
     except OSError:
         pass
 
 
 def locate_crashdump(run_or_dir):
     """Resolve a run name / run directory / file path to an existing
-    crashdump.json, or None.  Mirrors ``export.locate_stats``."""
+    crashdump, or None.  Mirrors ``export.locate_stats``; any rank's
+    dump counts — a fleet with one dead rank IS a crashed run (the
+    first match in rank order is returned)."""
+    dumps = locate_all_crashdumps(run_or_dir)
+    return dumps[0] if dumps else None
+
+
+def _rank_dumps_under(trace_dir):
+    """Every crashdump under one run's trace dir: the legacy rank-0
+    ``crashdump.json`` plus every ``rank<k>/crashdump.rank<k>.json``
+    (and tolerantly any ``crashdump*.json`` either place — artifacts
+    from future layouts must not hide a death)."""
+    import glob
+
+    out = []
+    for pat in ("crashdump.json", "crashdump.rank*.json",
+                "rank*/crashdump*.json"):
+        out.extend(glob.glob(os.path.join(trace_dir, pat)))
+    return sorted(set(out))
+
+
+def locate_all_crashdumps(run_or_dir):
+    """EVERY rank's crashdump for a run name / run dir / file path,
+    sorted (rank 0's legacy path first when present).  ``dampr-tpu-stats``
+    exit-code-3 detection scans this list so a killed non-zero rank is
+    never masked by a clean rank 0."""
     from . import export as _export
 
-    cands = []
+    dirs = []
     if os.path.isfile(run_or_dir):
-        d = os.path.dirname(os.path.abspath(run_or_dir))
-        cands.append(os.path.join(d, CRASHDUMP_FILE))
+        dirs.append(os.path.dirname(os.path.abspath(run_or_dir)))
     if os.path.isdir(run_or_dir):
-        cands.append(os.path.join(run_or_dir, CRASHDUMP_FILE))
-        cands.append(os.path.join(run_or_dir, "trace", CRASHDUMP_FILE))
-    cands.append(os.path.join(_export.run_trace_dir(run_or_dir),
-                              CRASHDUMP_FILE))
-    for c in cands:
-        if os.path.isfile(c):
-            return c
-    return None
+        dirs.append(run_or_dir)
+        dirs.append(os.path.join(run_or_dir, "trace"))
+    dirs.append(_export.run_trace_dir(run_or_dir, rank=0))
+    seen = []
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        for dump in _rank_dumps_under(d):
+            if dump not in seen:
+                seen.append(dump)
+        if seen:
+            break  # one resolved layout; don't mix candidate roots
+    return seen

@@ -108,6 +108,18 @@ def compact_record(summary):
             "io_wait_fraction"),
         "settings": _settings_snapshot(),
     }
+    proc = summary.get("process") or {}
+    if proc:
+        rec["process"] = {"process_id": proc.get("process_id", 0),
+                          "num_processes": proc.get("num_processes", 1)}
+    # Multi-rank corpus discipline: only rank 0 appends the RUN-LEVEL
+    # record the adaptation layer consumes; non-zero ranks tag theirs
+    # with ``rank`` so ``matching()`` excludes them — N ranks appending
+    # identical-shape records would otherwise collapse the per-stage
+    # medians onto one run's numbers N times over (and, under skew,
+    # steer sizing from whichever rank happened to write last).
+    if proc.get("process_id"):
+        rec["rank"] = proc["process_id"]
     rec["fingerprint"] = plan_fingerprint(rec["stage_shapes"])
     crit = summary.get("critpath")
     if crit:
@@ -211,10 +223,16 @@ def load(run_name):
 
 def matching(records, stage_shapes):
     """Records whose stage-shape sequence equals ``stage_shapes`` —
-    per-sid measurements are meaningless across plan shapes."""
+    per-sid measurements are meaningless across plan shapes.  Rank-tagged
+    records (non-zero ranks of a multi-process run) are excluded: each
+    rank sees the same global collectives, so its record duplicates rank
+    0's shape with rank-local timings — feeding them to the medians
+    would weight one run once per rank."""
     want = [s.get("shape") for s in stage_shapes or ()]
     return [r for r in records
-            if [s.get("shape") for s in r.get("stage_shapes") or ()] == want]
+            if not r.get("rank")
+            and [s.get("shape")
+                 for s in r.get("stage_shapes") or ()] == want]
 
 
 def _median(values):

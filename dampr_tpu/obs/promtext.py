@@ -42,10 +42,19 @@ def escape_label_value(value):
             .replace("\n", "\\n"))
 
 
-def _labels(run):
-    if not run:
+def _labels(run, rank=None):
+    parts = []
+    if run:
+        parts.append('run="{}"'.format(escape_label_value(run)))
+    if rank is not None:
+        # Fleet-aware exposition: every sample names its rank, so one
+        # Prometheus scraping N ranks' /metrics endpoints can group and
+        # diff per-rank series (the tf.data-service per-worker telemetry
+        # shape).
+        parts.append('rank="{}"'.format(escape_label_value(rank)))
+    if not parts:
         return ""
-    return '{{run="{}"}}'.format(escape_label_value(run))
+    return "{{{}}}".format(",".join(parts))
 
 
 def _num(v):
@@ -54,14 +63,16 @@ def _num(v):
     return repr(float(v))
 
 
-def _emit(lines, name, typ, value, run):
+def _emit(lines, name, typ, value, run, rank=None):
     lines.append("# TYPE {} {}".format(name, typ))
-    lines.append("{}{} {}".format(name, _labels(run), _num(value)))
+    lines.append("{}{} {}".format(name, _labels(run, rank), _num(value)))
 
 
-def render(metrics):
+def render(metrics, rank=None):
     """A live registry -> exposition text (counters, current gauges,
-    histogram summaries, sampler self-metrics)."""
+    histogram summaries, sampler self-metrics).  ``rank`` adds the
+    per-rank label the live ``/metrics`` endpoint (:mod:`.serve`)
+    always sets."""
     summary = metrics.summary()
     # Live gauges beat the last sample: snapshot() pulls callbacks now.
     snap = metrics.snapshot()
@@ -71,24 +82,32 @@ def render(metrics):
     summary = dict(summary, series={
         k: {"last": v["last"], "samples": 0, "peak": v["last"]}
         for k, v in series.items()})
-    return render_summary({"metrics": summary, "run": metrics.run})
+    return render_summary({"metrics": summary, "run": metrics.run},
+                          rank=rank)
 
 
-def render_summary(stats_summary):
+def render_summary(stats_summary, rank=None):
     """A persisted stats.json dict (or a fragment with a ``metrics``
     key) -> exposition text.  A run with no metrics section (or an
     empty registry) renders as the EMPTY exposition — zero bytes is the
     valid text-format encoding of "no samples", and scrapers/promtool
     accept it; callers that want to tell the user about it check
-    falsiness (the stats CLI does)."""
+    falsiness (the stats CLI does).  ``rank`` defaults from the
+    summary's own ``process`` block for multi-process runs, so a
+    persisted rank artifact exposes the same labels the live endpoint
+    serves."""
     m = stats_summary.get("metrics") or {}
     run = stats_summary.get("run")
+    if rank is None:
+        proc = stats_summary.get("process") or {}
+        if (proc.get("num_processes") or 1) > 1:
+            rank = proc.get("process_id", 0)
     lines = []
     counters = m.get("counters") or {}
     series = m.get("series") or {}
     for name in sorted(counters):
         _emit(lines, sanitize(name) + "_total", "counter", counters[name],
-              run)
+              run, rank)
     for name in sorted(series):
         if name in counters:
             continue  # already exported as a counter
@@ -98,21 +117,22 @@ def render_summary(stats_summary):
         v = meta["last"]
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue
-        _emit(lines, sanitize(name), "gauge", v, run)
+        _emit(lines, sanitize(name), "gauge", v, run, rank)
     for name in sorted(m.get("histograms") or {}):
         h = m["histograms"][name]
         base = sanitize(name)
         lines.append("# TYPE {} summary".format(base))
-        lines.append("{}_count{} {}".format(base, _labels(run),
+        lines.append("{}_count{} {}".format(base, _labels(run, rank),
                                             _num(h.get("count", 0))))
-        lines.append("{}_sum{} {}".format(base, _labels(run),
+        lines.append("{}_sum{} {}".format(base, _labels(run, rank),
                                           _num(h.get("sum", 0.0))))
         for k in ("min", "max"):
             if k in h:
-                _emit(lines, "{}_{}".format(base, k), "gauge", h[k], run)
+                _emit(lines, "{}_{}".format(base, k), "gauge", h[k],
+                      run, rank)
     sampler = m.get("sampler") or {}
     for k in ("samples", "series_drops", "overhead"):
         if k in sampler:
             _emit(lines, sanitize("sampler." + k), "gauge", sampler[k],
-                  run)
+                  run, rank)
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,158 @@
+"""Live metrics endpoint: a stdlib-only HTTP thread per rank.
+
+The obs plane so far is post-hoc (artifacts) or console-bound (the
+progress line).  This module is the first *service-shaped* surface — the
+piece ROADMAP item 3's disaggregated pipeline service scrapes — exposing
+the LIVE metrics registry while a run is still in flight:
+
+- ``GET /metrics`` — Prometheus text exposition (version 0.0.4) of the
+  active registry (:func:`dampr_tpu.obs.promtext.render`), every sample
+  rank-labeled (``rank="<k>"``), so one scrape config covering a fleet's
+  per-rank ports yields groupable per-worker series (the tf.data-service
+  per-worker telemetry shape, arXiv 2210.14826).  A process with no
+  metered run in flight serves the empty exposition (valid: zero
+  samples), never an error — scrapers must survive run boundaries.
+- ``GET /healthz`` — JSON liveness: run name, rank identity, whether a
+  registry is live.  The fleet's "is rank k up" probe.
+
+Enabled by ``settings.metrics_port`` (default 0 = off; the runner starts
+one server per run on ``metrics_port + process_id`` so co-located ranks
+never collide, and setting the port implies the 100 ms sampler so the
+gauges actually move).  Dependency-free by design: ``http.server``
+behind a daemon thread, request handling never touches the run's hot
+path — the registry's own locks bound a scrape's cost to one snapshot.
+"""
+
+import json
+import logging
+import threading
+
+log = logging.getLogger("dampr_tpu.obs.serve")
+
+#: The exposition content type Prometheus scrapers negotiate.
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer(object):
+    """One rank's live observability endpoint.
+
+    Serves whatever registry is ACTIVE at request time
+    (:func:`dampr_tpu.obs.metrics.active`) rather than binding one
+    registry at construction — the server outlives nothing (the runner
+    stops it at run teardown), but within a run this also makes it
+    correct for nested runs (innermost registry wins, same contract as
+    the tracer)."""
+
+    def __init__(self, port, run_name=None, rank=None, num_processes=None):
+        from ..parallel.mesh import rank_info
+
+        pid, num = rank_info()
+        self.rank = pid if rank is None else rank
+        self.num_processes = num if num_processes is None else num_processes
+        self.run_name = run_name
+        #: Requested port BEFORE the per-rank offset; 0 = OS-assigned
+        #: (tests).  ``self.port`` is the live bound port after start().
+        self.base_port = int(port)
+        self.port = None
+        self._httpd = None
+        self._thread = None
+
+    # -- request handling ---------------------------------------------------
+    def _metrics_text(self):
+        from . import metrics as _metrics, promtext
+
+        reg = _metrics.active()
+        if reg is None:
+            return ""
+        return promtext.render(reg, rank=self.rank)
+
+    def _health(self):
+        from . import metrics as _metrics
+
+        reg = _metrics.active()
+        return {
+            "status": "ok",
+            "run": (reg.run if reg is not None else self.run_name),
+            "process_id": self.rank,
+            "num_processes": self.num_processes,
+            "metrics_live": reg is not None,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Bind and serve on a daemon thread.  Returns self, or None
+        when the bind fails (port taken): a busy port degrades the
+        endpoint, never the run."""
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = server._metrics_text().encode("utf-8")
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         METRICS_CONTENT_TYPE)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    elif self.path.split("?")[0] == "/healthz":
+                        body = json.dumps(server._health()).encode("utf-8")
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self.send_error(404)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper went away mid-response
+
+            def log_message(self, fmt, *args):
+                log.debug("metrics endpoint: " + fmt, *args)
+
+        port = self.base_port
+        if port > 0:
+            # Per-rank offset: co-located ranks each get their own port
+            # (rank 0 = the configured port, rank k = port + k).
+            port += self.rank
+        try:
+            self._httpd = http.server.ThreadingHTTPServer(
+                ("", port), Handler)
+        except OSError as e:
+            log.warning("metrics endpoint bind failed on port %d: %s "
+                        "(endpoint disabled for this run)", port, e)
+            return None
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dampr-tpu-metrics-http")
+        self._thread.start()
+        log.info("metrics endpoint: rank %d serving /metrics + /healthz "
+                 "on port %d", self.rank, self.port)
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except Exception:
+                log.debug("metrics endpoint shutdown failed",
+                          exc_info=True)
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+
+def start_server(port, run_name=None):
+    """Convenience for the runner: build + start, returning the live
+    server or None (bind failure / port <= 0 with no override)."""
+    if port is None:
+        return None
+    srv = MetricsServer(port, run_name=run_name)
+    return srv.start()

@@ -123,6 +123,11 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
         budget=budget, gather=gather)
     sent = [0] * D
     received = [0] * D
+    pair = {}  # (src_device, dst_device) -> payload bytes this exchange
+    for s, d in blobs:
+        n = len(blobs[(s, d)])
+        if n:
+            pair[(s, d)] = pair.get((s, d), 0) + n
     parts = {}
     for i, step in enumerate(sched.steps):
         buf = np.zeros((D * D, step.capacity), dtype=np.uint8)
@@ -164,6 +169,8 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
         if received[d]:
             received_bytes_per_device[d] = (
                 received_bytes_per_device.get(d, 0) + received[d])
+    for sd, n in pair.items():
+        pair_bytes_per_route[sd] = pair_bytes_per_route.get(sd, 0) + n
     last_info = {
         "steps": sched.n_steps,
         "bytes": sched.total_bytes,
@@ -172,6 +179,10 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
         "clamped": sched.clamped,
         "sent_per_device": sent,
         "received_per_device": received,
+        # (src, dst) -> payload bytes: the full routing matrix of this
+        # exchange — obs.fleet folds device routes into the rank-level
+        # send/recv matrix the straggler diagnosis reads.
+        "pair_bytes": pair,
     }
     return out
 
@@ -202,6 +213,11 @@ peak_inflight_bytes = 0  # high-water mark across every schedule run
 #: aggregate total.
 sent_bytes_per_device = {}
 received_bytes_per_device = {}
+#: Cumulative (src_device, dst_device) -> payload bytes across every
+#: exchange this process ran: the device-route matrix.  The runner
+#: snapshots per-run deltas into ``stats()["mesh"]["exchange"]`` and
+#: obs.fleet aggregates routes into the rank x rank matrix.
+pair_bytes_per_route = {}
 
 
 def mesh_shuffle_blocks(mesh, routed):

@@ -98,9 +98,92 @@ def init_distributed(coordinator_address=None, num_processes=None,
     jax.distributed.initialize(**kwargs)
     global _initialized
     _initialized = True
+    _clock_handshake()
 
 
 _initialized = False
+
+#: Clock-alignment record from the post-init barrier handshake, or None
+#: (single-process, or the handshake collective failed).  Keys:
+#: ``barrier_perf`` — this process's ``time.perf_counter()`` captured the
+#: instant the post-init barrier collective RETURNED (every rank exits a
+#: barrier within network latency of the same wall moment, so this value
+#: is the per-rank anchor of one fleet-common instant — no wall-clock
+#: trust, NTP drift never enters the merged timeline); ``barrier_wall``
+#: — ``time.time()`` at the same instant (display only, never used for
+#: alignment); ``method`` — which collective produced the barrier.
+clock_sync = None
+
+
+def _clock_handshake():
+    """Barrier-timestamp handshake: run one collective every rank must
+    enter, and record the per-rank monotonic clock at its exit.  The
+    fleet trace merge (:mod:`dampr_tpu.obs.fleet`) subtracts each rank's
+    ``barrier_perf`` from its span timestamps, so per-rank timelines
+    align on the barrier instant instead of trusting wall clocks.
+    Best-effort: a failed handshake leaves ``clock_sync`` None and the
+    merge falls back to wall-start alignment (recorded as degraded)."""
+    global clock_sync
+    import time
+
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    method = None
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dampr_tpu_clock_handshake")
+        method = "sync_global_devices"
+    except Exception:
+        try:
+            # Older jax without multihost_utils: a tiny psum across all
+            # devices is an equivalent barrier (every process must
+            # contribute before any result materializes).
+            import numpy as np
+
+            val = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+                np.ones((len(jax.local_devices()),), dtype=np.float32))
+            np.asarray(val)
+            method = "psum"
+        except Exception:
+            clock_sync = None
+            return
+    clock_sync = {
+        "barrier_perf": time.perf_counter(),
+        "barrier_wall": time.time(),
+        "method": method,
+    }
+
+
+def rank_info():
+    """``(process_id, num_processes)`` WITHOUT forcing a jax backend
+    init: once the process group is up the authoritative jax values are
+    used; before that (or in never-distributed processes) the launcher
+    env (``DAMPR_TPU_PROCESS_ID`` / ``DAMPR_TPU_NUM_PROCESSES``, JAX_*
+    fallback) is read, defaulting to ``(0, 1)``.  This is the gate the
+    observability plane tags every artifact with — it must stay safe to
+    call from finalizers, crash paths, and CLI tools that never touch
+    jax."""
+    import os
+
+    if _initialized:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    raw_n = (os.environ.get("DAMPR_TPU_NUM_PROCESSES")
+             or os.environ.get("JAX_NUM_PROCESSES"))
+    raw_id = (os.environ.get("DAMPR_TPU_PROCESS_ID")
+              or os.environ.get("JAX_PROCESS_ID"))
+    try:
+        n = int(raw_n) if raw_n else 1
+        pid = int(raw_id) if raw_id not in (None, "") else 0
+    except ValueError:
+        return 0, 1
+    if n <= 1:
+        return 0, 1
+    return pid, n
 
 
 def maybe_init_distributed():

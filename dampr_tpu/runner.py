@@ -786,6 +786,13 @@ class MTRunner(object):
         # Per-operator profiler (settings.profile): attributes fused-stage
         # time to individual user ops; summary ships as stats()["profile"].
         self.profiler = None
+        # Live metrics endpoint (obs.serve, settings.metrics_port): one
+        # stdlib HTTP thread per rank while the run is in flight.
+        self._metrics_server = None
+        # Per-run device-route accounting: snapshot of the exchange
+        # module's cumulative per-device/per-route counters at run start,
+        # differenced at finalize so stats() carries THIS run's matrix.
+        self._exchange_snapshot = None
         # Failed runs must not feed the run-history corpus (their
         # measurements would poison the adaptation medians).
         self._run_failed = False
@@ -2343,6 +2350,27 @@ class MTRunner(object):
                     self.metrics, lambda: dict(self._status),
                     settings.progress_interval_ms)
                 self._progress.start()
+            if settings.metrics_port > 0:
+                # Live metrics endpoint: per-rank /metrics + /healthz on
+                # metrics_port + process_id (co-located ranks never
+                # collide).  Best-effort — a busy port degrades the
+                # endpoint, never the run.
+                from .obs import serve as _serve
+
+                self._metrics_server = _serve.start_server(
+                    settings.metrics_port, run_name=self.name)
+        # Route-matrix epoch: the exchange module's counters are
+        # process-cumulative; remember where they stood so finalize can
+        # attribute only this run's bytes.
+        try:
+            from .parallel import exchange as px
+
+            self._exchange_snapshot = (
+                dict(px.sent_bytes_per_device),
+                dict(px.received_bytes_per_device),
+                dict(px.pair_bytes_per_route))
+        except Exception:
+            self._exchange_snapshot = None
         return rec
 
     def _stop_obs(self):
@@ -2360,6 +2388,9 @@ class MTRunner(object):
             _profile.stop(self.profiler)
         if self.flightrec is not None:
             _flightrec.stop(self.flightrec)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
 
     def run(self, outputs, cleanup=True):
         from . import plan as _plan
@@ -2403,6 +2434,40 @@ class MTRunner(object):
             except Exception:
                 log.warning("stats/trace finalize failed", exc_info=True)
 
+    def _exchange_deltas(self):
+        """THIS run's per-device sent/received bytes and (src, dst)
+        device-route matrix: the exchange module's cumulative counters
+        minus the snapshot taken at run start.  None when nothing moved
+        (the section stays compact for host-only runs)."""
+        if self._exchange_snapshot is None:
+            return None
+        try:
+            from .parallel import exchange as px
+        except Exception:
+            return None
+        sent0, recv0, pair0 = self._exchange_snapshot
+
+        def delta(cur, base):
+            out = {}
+            for k, v in cur.items():
+                d = v - base.get(k, 0)
+                if d > 0:
+                    out[k] = d
+            return out
+
+        sent = delta(px.sent_bytes_per_device, sent0)
+        recv = delta(px.received_bytes_per_device, recv0)
+        pair = delta(px.pair_bytes_per_route, pair0)
+        if not (sent or recv or pair):
+            return None
+        return {
+            "sent_per_device": {str(k): v for k, v in sorted(sent.items())},
+            "received_per_device": {str(k): v
+                                    for k, v in sorted(recv.items())},
+            # JSON-safe route triples [src_device, dst_device, bytes]
+            "routes": [[s, d, n] for (s, d), n in sorted(pair.items())],
+        }
+
     def _finalize_obs(self, wall_start, wall, dev):
         """Build the per-run summary (the stats.json payload) and, when
         tracing, persist trace.json + stats.json under the run's trace
@@ -2416,6 +2481,10 @@ class MTRunner(object):
         summary = {
             "schema": _export.STATS_SCHEMA,
             "run": self.name,
+            # Rank identity on every artifact: which process of how many
+            # produced this summary (plus the clock-handshake anchor when
+            # the process group ran one) — the key obs.fleet merges on.
+            "process": _export.process_section(),
             "started_at": round(wall_start, 3),
             "wall_seconds": round(wall, 4),
             "n_partitions": self.n_partitions,
@@ -2495,6 +2564,11 @@ class MTRunner(object):
                                     or {}).get("mesh_stages", 0),
                 },
             },
+        }
+        ex_delta = self._exchange_deltas()
+        if ex_delta is not None:
+            summary["mesh"]["exchange"].update(ex_delta)
+        summary.update({
             # Device execution: run-wide device counters — device_fraction
             # is thread-seconds inside ANY jitted kernel (lowered programs,
             # segment folds, the hash lexsort, mesh collectives) over wall,
@@ -2521,7 +2595,7 @@ class MTRunner(object):
             "plan": self.plan_report or {"enabled": False},
             "trace_file": None,
             "stats_file": None,
-        }
+        })
         if self.metrics is not None:
             # Counters, gauge peaks/lasts, histogram summaries, and the
             # sampler's self-accounting (samples, series drops, the
@@ -2555,6 +2629,30 @@ class MTRunner(object):
             summary["stats_file"] = spath
             _export.write_stats(summary, spath)
             log.info("trace: %s · stats: %s", summary["trace_file"], spath)
+            # Fleet merge: rank 0 of a healthy multi-process traced run
+            # waits (bounded) for its siblings' per-rank artifacts, then
+            # builds the merged clock-aligned timeline + the
+            # stats()["fleet"] section — persisted back into stats.json
+            # AND visible on the in-memory summary.  A dead sibling
+            # cannot wedge the survivor: past fleet_wait_ms the merge
+            # proceeds with whatever landed and records the missing
+            # ranks.  Single-process runs never enter (back-compat pin:
+            # no fleet section, identical artifact layout).
+            proc = summary.get("process") or {}
+            if (proc.get("num_processes", 1) > 1
+                    and not proc.get("process_id")
+                    and not self._run_failed
+                    and settings.fleet_wait_ms > 0):
+                try:
+                    from .obs import fleet as _fleet
+
+                    fl = _fleet.merge_run(
+                        self.name, wait_ms=settings.fleet_wait_ms,
+                        summary=summary)
+                    if fl is not None:
+                        summary["fleet"] = fl
+                except Exception:
+                    log.warning("fleet trace merge failed", exc_info=True)
         self.run_summary = summary
         if not self._run_failed:
             # Run-history corpus: one compact record per FINALIZED run

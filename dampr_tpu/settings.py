@@ -529,12 +529,34 @@ metrics_interval_ms = int(os.environ.get("DAMPR_TPU_METRICS_MS", "0"))
 def effective_metrics_interval_ms():
     """The sampling cadence actually in force: the explicit setting, or
     the 100 ms traced-run default (a traced run's crashdump must carry
-    recent gauge samples), or 0 = metrics plane off."""
+    recent gauge samples), or 0 = metrics plane off.  A live metrics
+    endpoint (``metrics_port``) also implies sampling — a scraper
+    polling ``/metrics`` must see moving gauges, not a dead registry."""
     if metrics_interval_ms > 0:
         return metrics_interval_ms
-    if trace or progress:
+    if trace or progress or metrics_port > 0:
         return 100
     return 0
+
+
+#: Live metrics endpoint (dampr_tpu.obs.serve): when > 0 every run
+#: starts a stdlib-only HTTP thread on this port exposing ``/metrics``
+#: (Prometheus text exposition of the live registry, rank-labeled) and
+#: ``/healthz``.  Multi-process deployments bind ``metrics_port +
+#: process_id`` per rank so co-located ranks never collide.  0 (the
+#: default) serves nothing — the run pays only the usual metrics-plane
+#: cost, and with the plane off too, nothing at all.
+metrics_port = int(os.environ.get("DAMPR_TPU_METRICS_PORT", "0"))
+
+#: How long (milliseconds) rank 0 waits at finalize for its sibling
+#: ranks' per-rank trace/stats artifacts before building the merged
+#: fleet timeline (dampr_tpu.obs.fleet).  Ranks in a collective pipeline
+#: finish near-lockstep, so the wait is normally milliseconds; a killed
+#: sibling must not wedge the survivor, so past the deadline rank 0
+#: merges what landed and records the missing ranks.  0 disables the
+#: finalize-time merge entirely (``dampr-tpu-stats --fleet`` still
+#: merges post-hoc).
+fleet_wait_ms = int(os.environ.get("DAMPR_TPU_FLEET_WAIT_MS", "10000"))
 
 
 #: Live in-run progress reporter (dampr_tpu.obs.progress): when True,

@@ -41,6 +41,8 @@ MODULES = [
     "dampr_tpu.obs.progress",
     "dampr_tpu.obs.promtext",
     "dampr_tpu.obs.flightrec",
+    "dampr_tpu.obs.fleet",
+    "dampr_tpu.obs.serve",
     "dampr_tpu.obs.export",
     "dampr_tpu.obs.profile",
     "dampr_tpu.obs.critpath",

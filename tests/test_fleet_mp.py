@@ -1,0 +1,369 @@
+"""Multi-process pins for the fleet observability plane: rank-tagged
+artifacts from REAL separate processes.
+
+Three legs, two spawn styles:
+
+- env-rank workers (DAMPR_TPU_PROCESS_ID/_NUM_PROCESSES, no coordinator,
+  no jax.distributed): pin the history-corpus rank discipline and the
+  crashdump rank attribution — both only need rank *identity*, which by
+  design never forces a process group;
+- a full gloo 2-process deployment (localhost coordinator, the PR-8
+  rig): the clock handshake runs at init, both ranks trace a chunked
+  byte exchange with an artificial straggler, rank 0 merges the fleet
+  timeline and the skew math must name the sleeping rank.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_env_ranks(tmp_path, sources, extra_env=None, timeout=180):
+    """Spawn one process per source with rank env vars set (no process
+    group).  Returns [(rc, out, err)] in rank order."""
+    scratch = str(tmp_path / "scratch")
+    outs = []
+    procs = []
+    for rank, src in enumerate(sources):
+        script = str(tmp_path / "worker{}.py".format(rank))
+        with open(script, "w") as f:
+            f.write(src)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "DAMPR_TPU_NUM_PROCESSES": str(len(sources)),
+            "DAMPR_TPU_PROCESS_ID": str(rank),
+            "DAMPR_TPU_SCRATCH": scratch,
+            "DAMPR_TPU_TRACE": "1",
+            "DAMPR_TPU_FLEET_WAIT_MS": "2000",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, script], stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env))
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    return scratch, outs
+
+
+_HIST_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {root!r})
+    from dampr_tpu import Dampr
+    out = (Dampr.memory(list(range(3000)), partitions=4)
+           .count(lambda x: x % 11))
+    em = out.run("mp-hist")
+    assert sorted(v for _k, v in em.read()) == sorted(
+        [273] * 8 + [272] * 3), "results diverged"
+    print("HIST_OK")
+""").format(root=ROOT)
+
+
+_CRASH_WORKER_OK = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {root!r})
+    from dampr_tpu import Dampr
+    em = (Dampr.memory(list(range(1000)), partitions=2)
+          .count(lambda x: x % 3)).run("mp-crash")
+    list(em.read())
+    print("CRASH0_OK")
+""").format(root=ROOT)
+
+
+_CRASH_WORKER_DIES = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {root!r})
+    from dampr_tpu import Dampr
+
+    def boom(x):
+        if x == 500:
+            raise RuntimeError("rank1 injected failure")
+        return (x, 1)
+
+    try:
+        em = (Dampr.memory(list(range(1000)), partitions=2)
+              .map(boom).run("mp-crash"))
+        list(em.read())
+    except Exception:
+        print("CRASH1_DIED")
+        raise SystemExit(7)
+    raise SystemExit(0)  # should not be reached
+""").format(root=ROOT)
+
+
+class TestEnvRankProcesses:
+    def test_history_corpus_rank_discipline(self, tmp_path):
+        """Two ranks of one logical run append to the shared corpus:
+        only rank 0's record feeds adaptation; rank 1's is rank-tagged
+        and excluded by matching()/synthesize() (the multi-rank
+        pollution fix)."""
+        scratch, outs = _run_env_ranks(
+            tmp_path, [_HIST_WORKER, _HIST_WORKER])
+        for rank, (rc, out, err) in enumerate(outs):
+            assert rc == 0, (rank, out, err[-2000:])
+            assert "HIST_OK" in out
+        corpus = os.path.join(scratch, "mp-hist", "history.jsonl")
+        assert os.path.isfile(corpus), os.listdir(scratch)
+        recs = [json.loads(ln) for ln in open(corpus) if ln.strip()]
+        assert len(recs) == 2, recs
+        tagged = [r for r in recs if r.get("rank")]
+        untagged = [r for r in recs if not r.get("rank")]
+        assert len(tagged) == 1 and tagged[0]["rank"] == 1
+        assert len(untagged) == 1
+        assert untagged[0]["process"]["num_processes"] == 2
+        # the adaptation layer sees exactly ONE run, not one per rank
+        sys.path.insert(0, ROOT)
+        from dampr_tpu.obs import history as H
+
+        shapes = untagged[0]["stage_shapes"]
+        matched = H.matching(recs, shapes)
+        assert len(matched) == 1 and not matched[0].get("rank")
+        assert H.synthesize(matched)["history_entries"] == 1
+
+    def test_per_rank_trace_artifacts_land(self, tmp_path):
+        scratch, outs = _run_env_ranks(
+            tmp_path, [_HIST_WORKER, _HIST_WORKER])
+        base = os.path.join(scratch, "mp-hist", "trace")
+        assert os.path.isfile(os.path.join(base, "stats.json"))
+        assert os.path.isfile(os.path.join(base, "rank1", "stats.json"))
+        with open(os.path.join(base, "rank1", "stats.json")) as f:
+            s1 = json.load(f)
+        assert s1["process"] == {"process_id": 1, "num_processes": 2}
+        # rank 0 merged what it could (env ranks have no clock
+        # handshake -> wall alignment; no collectives -> no skew)
+        with open(os.path.join(base, "stats.json")) as f:
+            s0 = json.load(f)
+        fl = s0.get("fleet")
+        assert fl is not None, "rank 0 should have merged the fleet"
+        assert fl["num_processes"] == 2
+        assert fl["alignment"] == "wall"
+        assert {e["rank"] for e in fl["per_rank"]} == {0, 1}
+        assert os.path.isfile(fl["merged_trace_file"])
+
+    def test_killed_rank_leaves_named_crashdump(self, tmp_path):
+        """Satellite pin: rank 1 dies mid-run; the surviving artifacts
+        name the dead rank (crashdump.rank1.json + stats exit 3)."""
+        scratch, outs = _run_env_ranks(
+            tmp_path, [_CRASH_WORKER_OK, _CRASH_WORKER_DIES])
+        rc0, out0, err0 = outs[0]
+        rc1, out1, err1 = outs[1]
+        assert rc0 == 0, (out0, err0[-2000:])
+        assert rc1 == 7 and "CRASH1_DIED" in out1, (out1, err1[-2000:])
+        base = os.path.join(scratch, "mp-crash", "trace")
+        dump = os.path.join(base, "rank1", "crashdump.rank1.json")
+        assert os.path.isfile(dump), (
+            "dead rank's dump missing; tree: %r"
+            % [os.path.join(dp, f) for dp, _d, fs in os.walk(base)
+               for f in fs])
+        with open(dump) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["process"]["process_id"] == 1
+        assert doc["otherData"]["crash"]["reason"] == "run-failed"
+        # rank 0's legacy layout is intact and dump-free
+        assert os.path.isfile(os.path.join(base, "stats.json"))
+        assert not os.path.isfile(os.path.join(base, "crashdump.json"))
+
+        # the stats CLI scans ALL rank dumps: exit 3 naming rank 1
+        sys.path.insert(0, ROOT)
+        from dampr_tpu.obs import flightrec
+
+        dumps = flightrec.locate_all_crashdumps(
+            os.path.join(scratch, "mp-crash"))
+        assert dumps == [dump]
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, {root!r}); "
+             "sys.argv = ['dampr-tpu-stats', sys.argv[1]]; "
+             "from dampr_tpu.cli import stats; stats()".format(root=ROOT),
+             os.path.join(scratch, "mp-crash")],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+        assert proc.returncode == 3, (proc.stdout, proc.stderr)
+        assert "rank 1" in proc.stderr
+        assert "crashdump.rank1.json" in proc.stderr
+
+
+_GLOO_WORKER = textwrap.dedent("""
+    import os, sys, time
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, "@ROOT@")
+    from dampr_tpu import settings
+    settings.scratch_root = os.environ["DAMPR_TPU_SCRATCH"]
+    from dampr_tpu.parallel import mesh as M
+    from dampr_tpu.parallel.mesh import init_distributed, data_mesh
+    init_distributed(coordinator_address="localhost:%s" % port,
+                     num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and len(jax.devices()) == 8
+    # the clock handshake ran at init and anchored this rank
+    assert M.clock_sync is not None, "clock handshake did not run"
+    assert M.clock_sync["barrier_perf"] > 0
+    assert M.rank_info() == (pid, 2)
+
+    import numpy as np
+    from dampr_tpu.obs import export, fleet, trace as T
+    from dampr_tpu.parallel import exchange as px
+    mesh = data_mesh()
+    D = 8
+    rng = np.random.RandomState(3)
+    blobs = {}
+    for s in range(D):
+        for d in range(D):
+            if (s + d) % 3 == 0:
+                blobs[(s, d)] = rng.randint(
+                    0, 256, size=6000).astype(np.uint8).tobytes()
+    budget = 1 << 18
+    px.mesh_blob_exchange(mesh, blobs, budget=budget)  # warm/compile
+
+    run = "mp-fleet"
+    tracer = T.Tracer(run)
+    T.start(tracer)
+    w0 = time.time()
+    if pid == 1:
+        time.sleep(0.4)  # artificial straggler: rank 1 arrives late
+    out = px.mesh_blob_exchange(mesh, blobs, budget=budget)
+    T.stop(tracer)
+    wall = time.time() - w0
+    assert out == blobs, "exchange diverged on rank %d" % pid
+    info = px.last_info
+    assert info["steps"] >= 1
+
+    proc = export.process_section()
+    assert proc["process_id"] == pid and proc["num_processes"] == 2
+    assert "clock" in proc
+    tdir = export.run_trace_dir(run)
+    os.makedirs(tdir, exist_ok=True)
+    tf = export.write_trace(tracer, os.path.join(tdir, export.TRACE_FILE))
+    summary = {
+        "schema": export.STATS_SCHEMA,
+        "run": run, "process": proc,
+        "started_at": round(w0, 3), "wall_seconds": round(wall, 4),
+        "stages": [],
+        "totals": {"records_out": 0, "bytes_out": info["bytes"],
+                   "spill_bytes": 0},
+        "mesh": {"exchange": {
+            "bytes": info["bytes"], "steps": info["steps"],
+            "peak_inflight_bytes": info["peak_inflight_bytes"],
+            "hbm_budget": budget,
+            "sent_per_device": {str(k): v for k, v in
+                                px.sent_bytes_per_device.items()},
+            "received_per_device": {str(k): v for k, v in
+                                    px.received_bytes_per_device.items()},
+            "routes": [[s, d, n] for (s, d), n in
+                       sorted(px.pair_bytes_per_route.items())],
+        }},
+        "spans": tracer.span_summary(),
+        "trace_file": tf,
+    }
+    export.write_stats(summary, os.path.join(tdir, export.STATS_FILE))
+
+    if pid == 0:
+        import json
+        section = fleet.merge_run(run, wait_ms=20000)
+        assert section is not None, "merge produced nothing"
+        assert section["alignment"] == "clock", section["alignment"]
+        assert section["missing_ranks"] == []
+        skew = section.get("skew")
+        assert skew, "no skew computed from exchange step spans"
+        for st in skew["steps"]:
+            assert 0.0 <= st["fraction"] <= 1.0, st
+        assert skew["straggler_rank"] == 1, skew
+        assert skew["skew_seconds"] >= 0.3, skew
+        assert os.path.isfile(section["merged_trace_file"])
+        ex = section.get("exchange")
+        assert ex and ex["bytes"] > 0
+        assert len(ex["rank_sent_matrix"]) == 2
+        print("FLEET_JSON=" + json.dumps(
+            {"merged": section["merged_trace_file"],
+             "straggler": skew["straggler_rank"],
+             "mean_fraction": skew["mean_fraction"]}))
+    print("FLEETP_%d_OK" % pid, flush=True)
+""").replace("@ROOT@", ROOT)
+
+
+class TestTwoProcessFleet:
+    def test_traced_gloo_exchange_merges_with_clock_skew(self, tmp_path):
+        """The acceptance path end-to-end: 2 gloo ranks trace a chunked
+        exchange, rank 1 is an injected straggler, rank 0's merged
+        timeline aligns on the init-time clock handshake and the skew
+        math names rank 1.  The merged trace must validate against the
+        checked-in schema."""
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["DAMPR_TPU_SCRATCH"] = str(tmp_path / "scratch")
+        script = str(tmp_path / "gloo_worker.py")
+        with open(script, "w") as f:
+            f.write(_GLOO_WORKER)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(i), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            for i in range(2)]
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append((p.returncode, out, err))
+        for i, (rc, out, err) in enumerate(outs):
+            assert rc == 0, (i, out, err[-3000:])
+            assert "FLEETP_%d_OK" % i in out, (i, out, err[-2000:])
+        line = [ln for ln in outs[0][1].splitlines()
+                if ln.startswith("FLEET_JSON=")][0]
+        info = json.loads(line.split("=", 1)[1])
+        assert info["straggler"] == 1
+
+        # parent-side: the merged artifact is Perfetto-loadable and
+        # schema-valid with per-rank process lanes
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_trace", os.path.join(ROOT, "tools",
+                                           "validate_trace.py"))
+        vt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vt)
+        with open(info["merged"]) as f:
+            doc = json.load(f)
+        with open(os.path.join(ROOT, "docs", "trace_schema.json")) as f:
+            schema = json.load(f)
+        errors = vt.validate(doc, schema, require_cats=("exchange",))
+        assert errors == [], errors
+        pids = {ev["pid"] for ev in doc["traceEvents"]}
+        assert pids == {1, 2}
+        lanes = [ev for ev in doc["traceEvents"]
+                 if ev.get("ph") == "M" and ev["name"] == "process_name"]
+        names = {ev["args"]["name"] for ev in lanes}
+        assert {"rank0/2", "rank1/2"} <= names, names
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
