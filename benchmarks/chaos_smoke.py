@@ -1,0 +1,239 @@
+"""Chaos-exactness smoke: the failure-recovery contract, end to end.
+
+Runs a bench pipeline twice — once clean, once under an injected fault
+schedule (transient spill-write/read faults, a transient UDF fault to
+force a job retry) against an input carrying ONE deterministically
+poisoned record — and asserts:
+
+- results are **byte-identical** (the poisoned record is quarantined,
+  every transient fault is absorbed by a retry layer);
+- ``stats()["faults"]`` reports ``retries > 0`` and ``quarantined == 1``;
+- the traced chaos run's trace.json validates against the checked-in
+  schema (fault instants included).
+
+    python benchmarks/chaos_smoke.py --mode sort  --mb 8
+    python benchmarks/chaos_smoke.py --mode tfidf --mb 4
+
+The acceptance-scale runs are ``--mode sort --mb 256`` and ``--mode
+tfidf --mb 64``.  Exits nonzero on any violated invariant; emits one
+JSON line (metric/value keyed for tools/check_bench.py) on success.
+See docs/robustness.md.
+"""
+
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The poisoned record: a line no numeric parse survives and the fault
+#: plan's ``udf:match=POISON`` keys on.  Appended once to the chaos
+#: input; the clean baseline runs WITHOUT it, so byte-identical results
+#: prove the quarantine removed exactly that record.
+POISON_LINE = "POISON_RECORD_0xDEAD"
+
+#: One rule per site.  The sort pipeline's poison is DATA-level
+#: (``int()`` raises on the poison line), so its ``udf`` slot carries a
+#: one-shot transient fault to force a job retry; the tfidf pipeline's
+#: UDFs digest anything, so its ``udf`` slot carries the content-keyed
+#: deterministic poison and the transient rides the fold site instead.
+FAULT_PLANS = {
+    "sort": ("spill_write:p=0.02;spill_read:p=0.01;"
+             "udf:nth=2,kind=transient,times=1;seed=7"),
+    "tfidf": ("spill_write:p=0.02;spill_read:p=0.01;"
+              "fold:nth=2,kind=transient,times=1;"
+              "udf:match=POISON,kind=deterministic;seed=7"),
+}
+
+
+def make_numbers(path, mb, seed=7):
+    import numpy as np
+
+    if os.path.exists(path) and os.path.getsize(path) >= mb * 1024 ** 2:
+        return
+    rng = np.random.RandomState(seed)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    target = mb * 1024 ** 2
+    written = 0
+    with open(path, "w") as f:
+        while written < target:
+            ks = rng.randint(0, 1 << 62, size=50000)
+            chunk = "\n".join(str(k) for k in ks) + "\n"
+            f.write(chunk)
+            written += len(chunk)
+
+
+def make_docs(path, mb, seed=11):
+    import numpy as np
+
+    if os.path.exists(path) and os.path.getsize(path) >= mb * 1024 ** 2:
+        return
+    rng = np.random.RandomState(seed)
+    vocab = ["w{:04d}".format(i) for i in range(4096)]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    target = mb * 1024 ** 2
+    written = 0
+    with open(path, "w") as f:
+        while written < target:
+            n = int(rng.randint(5, 25))
+            words = [vocab[int(i)] for i in rng.randint(0, len(vocab),
+                                                        size=n)]
+            line = " ".join(words) + "\n"
+            f.write(line)
+            written += len(line)
+
+
+def with_poison(clean_path):
+    poisoned = clean_path + ".poisoned"
+    with open(clean_path, "rb") as src, open(poisoned, "wb") as dst:
+        dst.write(src.read())
+        dst.write((POISON_LINE + "\n").encode())
+    return poisoned
+
+
+def build_pipe(mode, path):
+    from dampr_tpu import Dampr
+
+    if mode == "sort":
+        # int() raises ValueError on the poison line — a genuinely
+        # poisoned record, not merely an injected one.
+        return (Dampr.text(path)
+                .map(int)
+                .sort_by(lambda x: x))
+    assert mode == "tfidf"
+    # Word counts over the corpus (the TF side of TF-IDF; the poison
+    # line is killed by the injected udf:match rule).
+    return (Dampr.text(path)
+            .flat_map(lambda line: line.split())
+            .count(lambda w: w))
+
+
+def digest(em):
+    """SHA-256 over the emitted value stream, in emission order (the
+    DSL's key-sorted read) — byte-identity means identical values in
+    identical order."""
+    h = hashlib.sha256()
+    n = 0
+    for v in em.read():
+        h.update(repr(v).encode())
+        n += 1
+    return h.hexdigest(), n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("sort", "tfidf"), default="sort")
+    ap.add_argument("--mb", type=int, default=8)
+    ap.add_argument("--budget-mb", type=int, default=8)
+    ap.add_argument("--dir", default="/tmp/dampr_tpu_chaos")
+    args = ap.parse_args()
+
+    from dampr_tpu import faults, settings
+
+    settings.use_device = False
+    settings.max_memory_per_stage = args.budget_mb * 1024 ** 2
+    settings.trace = True
+    settings.trace_dir = os.path.join(args.dir, "traces")
+    settings.scratch_root = os.path.join(args.dir, "scratch")
+
+    clean_path = os.path.join(
+        args.dir, "{}_{}mb.txt".format(args.mode, args.mb))
+    (make_numbers if args.mode == "sort" else make_docs)(
+        clean_path, args.mb)
+    poisoned_path = with_poison(clean_path)
+
+    # -- clean baseline: no faults, no poison record -------------------------
+    settings.faults = None
+    faults.clear()
+    settings.job_retries = 0
+    settings.max_quarantined = 0
+    em = build_pipe(args.mode, clean_path).run(
+        name="chaos-{}-clean".format(args.mode))
+    clean_digest, clean_n = digest(em)
+    em.delete()
+
+    # -- chaos leg: fault schedule + one poisoned record ---------------------
+    settings.faults = FAULT_PLANS[args.mode]
+    settings.job_retries = 3
+    settings.max_quarantined = 1
+    t0 = time.time()
+    em = build_pipe(args.mode, poisoned_path).run(
+        name="chaos-{}-chaos".format(args.mode))
+    secs = time.time() - t0
+    chaos_digest, chaos_n = digest(em)
+    stats = em.stats()
+    fa = stats["faults"]
+    em.delete()
+    settings.faults = None
+    faults.clear()
+    settings.job_retries = 0
+    settings.max_quarantined = 0
+
+    failures = []
+    if chaos_digest != clean_digest or chaos_n != clean_n:
+        failures.append(
+            "results diverged: clean {} ({} records) vs chaos {} ({})"
+            .format(clean_digest[:16], clean_n, chaos_digest[:16],
+                    chaos_n))
+    if fa.get("retries", 0) <= 0:
+        failures.append("no retries absorbed under the fault schedule: "
+                        "{}".format(fa))
+    if fa.get("quarantined") != 1:
+        failures.append("expected exactly 1 quarantined record, got "
+                        "{}".format(fa.get("quarantined")))
+
+    # Trace schema validity (fault instants included).
+    trace_file = stats.get("trace_file")
+    trace_valid = None
+    if trace_file and os.path.isfile(trace_file):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_trace", os.path.join(ROOT, "tools",
+                                           "validate_trace.py"))
+        vt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vt)
+        with open(os.path.join(ROOT, "docs", "trace_schema.json")) as f:
+            schema = json.load(f)
+        with open(trace_file) as f:
+            doc = json.load(f)
+        errors = vt.validate(doc, schema)
+        trace_valid = not errors
+        if errors:
+            failures.append("chaos trace failed schema validation: "
+                            "{}".format(errors[:5]))
+
+    out = {
+        "bench": "chaos_smoke",
+        "metric": "chaos_{}_records_per_s".format(args.mode),
+        "value": round(chaos_n / secs, 2) if secs > 0 else 0.0,
+        "mode": args.mode,
+        "mb": args.mb,
+        "records": chaos_n,
+        "seconds": round(secs, 3),
+        "byte_identical": chaos_digest == clean_digest,
+        "retries": fa.get("retries"),
+        "job_retries": fa.get("job_retries"),
+        "io_retries": fa.get("io_retries"),
+        "quarantined": fa.get("quarantined"),
+        "injected": fa.get("injected"),
+        "backoff_seconds": fa.get("backoff_seconds"),
+        "trace_valid": trace_valid,
+        "fault_plan": FAULT_PLANS[args.mode],
+        "ok": not failures,
+    }
+    print(json.dumps(out))
+    if failures:
+        for msg in failures:
+            print("CHAOS FAILURE: {}".format(msg), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,9 @@ import logging
 import random
 import sys
 import threading
+import time
 
+from . import faults as _faults
 from . import settings
 from .base import (AssocFoldReducer, ComposedMapper, Filter, FlatMap, Inspect,
                    KeyedInnerJoin, KeyedLeftJoin, KeyedOuterJoin, KeyedReduce,
@@ -101,6 +103,57 @@ class ValueEmitter(object):
 
 
 
+log = logging.getLogger("dampr_tpu.dampr")
+
+
+def _drive_runner(make_runner, sources, resume):
+    """Execute a run, with crash auto-resume when ``resume="auto"``.
+
+    Auto mode behaves like ``resume=True`` (durable per-stage
+    checkpoints) plus a whole-run retry loop: a failed run rebuilds a
+    FRESH runner (the old one's store/obs state died with it) and
+    re-executes — :mod:`dampr_tpu.resume` restores every stage whose
+    manifest survived, so only work past the last durable checkpoint
+    repeats, and results are byte-identical to a cold run (the resume
+    exactness contract).  Fatal failures (kills, MemoryError,
+    quarantine overflow) never auto-resume; transient-classified
+    failures back off with jitter between attempts.  Returns
+    ``(runner, datasets)``."""
+    from . import plan as _plan
+
+    auto = isinstance(resume, str) and resume.lower() == "auto"
+    attempts = (max(0, settings.run_retries) + 1) if auto else 1
+    prev_quarantine = None
+    for attempt in range(attempts):
+        runner = make_runner()
+        if prev_quarantine is not None and getattr(
+                runner, "_quarantine", None) is not None:
+            # The retry adopts the failed attempt's quarantine: its
+            # committed records (whose stages may now restore from
+            # checkpoints without re-running) keep their budget charge
+            # and audit lines — the fresh runner's constructor had
+            # truncated the sink, so re-materialize it.
+            runner._quarantine = prev_quarantine
+            prev_quarantine.rewrite_sink()
+        _plan.apply_to_runner(runner, sources)
+        try:
+            return runner, runner.run(sources)
+        except BaseException as e:
+            prev_quarantine = getattr(runner, "_quarantine", None)
+            kind = _faults.classify(e)
+            if kind == "fatal" or attempt + 1 >= attempts:
+                raise
+            delay = _faults.backoff(attempt) if kind == "transient" else 0.0
+            log.warning(
+                "run failed (%s: %s — classified %s); auto-resume "
+                "attempt %d/%d re-executes from the last durable "
+                "checkpoint%s", type(e).__name__, str(e)[:300], kind,
+                attempt + 2, attempts,
+                " in %.0f ms" % (delay * 1000) if delay else "")
+            if delay:
+                time.sleep(delay)
+
+
 class PBase(object):
     def __init__(self, source, pmer):
         assert isinstance(source, Source)
@@ -116,6 +169,14 @@ class PBase(object):
         with the SAME ``name`` skips every stage whose checkpoint is still
         valid (see :mod:`dampr_tpu.resume`).  Requires an explicit name —
         an auto-generated one can never match a previous run.
+
+        ``resume="auto"`` adds crash recovery on top: a run that fails
+        with a non-fatal error re-executes in place (up to
+        ``settings.run_retries`` times, transient failures backing off
+        with jitter) from its last durable checkpoint manifest, and the
+        result is byte-identical to a cold run.  Fatal failures
+        (``MemoryError``, kills, quarantine-budget overflow) never
+        auto-resume.  See ``docs/robustness.md``.
 
         Input-file identity is (path, size, mtime_ns) plus a content hash
         of the first and last 64KB.  An edit that preserves size AND
@@ -137,16 +198,13 @@ class PBase(object):
             name = "dampr/{}".format(random.random())
         if settings.seed is not None:
             _reset_sample_rngs()
-        runner = self.pmer.runner(name, self.pmer.graph, **kwargs)
-        # The logical plan optimizer (dampr_tpu.plan): rewrites the stage
-        # list — map fusion, combiner hoisting, dead-stage elimination,
-        # stats-driven sizing — before execution.  settings.optimize=False
-        # runs the graph exactly as constructed.  Idempotent: MTRunner.run
-        # re-checks, so direct-runner users get the same treatment.
-        from . import plan as _plan
-
-        _plan.apply_to_runner(runner, [self.source])
-        ds = runner.run([self.source])
+        # The logical plan optimizer (dampr_tpu.plan) rewrites the stage
+        # list before execution (applied inside _drive_runner, which
+        # also implements resume="auto" crash recovery: a failed run
+        # re-executes from its last durable checkpoint manifest).
+        runner, ds = _drive_runner(
+            lambda: self.pmer.runner(name, self.pmer.graph, **kwargs),
+            [self.source], kwargs.get("resume"))
         em = ValueEmitter(ds[0])
         em.stats = RunStats(
             [s.as_dict() for s in getattr(runner, "stats", [])],
@@ -712,11 +770,9 @@ class Dampr(object):
         name = kwargs.pop("name", "dampr/{}".format(random.random()))
         if settings.seed is not None:
             _reset_sample_rngs()
-        runner = pmer.pmer.runner(name, graph, **kwargs)
-        from . import plan as _plan
-
-        _plan.apply_to_runner(runner, sources)
-        ds = runner.run(sources)
+        runner, ds = _drive_runner(
+            lambda: pmer.pmer.runner(name, graph, **kwargs),
+            sources, kwargs.get("resume"))
         stats = RunStats([s.as_dict() for s in getattr(runner, "stats", [])],
                          getattr(runner, "run_summary", None))
         emitters = []

@@ -36,6 +36,7 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
+from .. import faults as _faults
 from . import codecs
 
 MAGIC = b"DTFR"
@@ -159,7 +160,16 @@ class FrameReader(object):
 
     def read_frame(self, i):
         """Read + decompress frame ``i`` -> payload bytes.  Thread-safe
-        (pread); raises ``FrameFormatError`` on short reads."""
+        (pread); raises ``FrameFormatError`` on short reads.  Transient
+        read failures (flaky disk, injected ``spill_read`` faults) retry
+        in place with backoff (``settings.io_retries``) — pread of an
+        immutable published file is idempotent; format errors are
+        deterministic and propagate immediately."""
+        return _faults.retry_io(lambda: self._read_frame_once(i),
+                                "spill_read")
+
+    def _read_frame_once(self, i):
+        _faults.check("spill_read")
         off, cid, raw_len, comp_len, _records = self.index[i]
         data = os.pread(self._fd, _FRAME.size + comp_len, off)
         if len(data) < _FRAME.size + comp_len:

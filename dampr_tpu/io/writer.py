@@ -33,6 +33,7 @@ import queue
 import threading
 import time
 
+from .. import faults as _faults
 from ..obs import trace as _trace
 from . import frames
 
@@ -137,16 +138,35 @@ class SpillWriterPool(object):
                 continue
             _trace.complete("spill_queue", "queued", t_enq, bytes=nbytes)
             tmp = final + ".tmp"
+
+            def write_once():
+                # Idempotent by construction (tmp -> fsync -> rename), so
+                # transient disk failures — including injected
+                # ``spill_write`` faults — retry in place with backoff
+                # instead of failing the run.  The fault site sits inside
+                # the retried body so chaos schedules exercise exactly
+                # the production retry path.
+                _faults.check("spill_write")
+                try:
+                    with _trace.span("spill", "spill-write", bytes=nbytes,
+                                     records=len(block)):
+                        with open(tmp, "wb") as f:
+                            frames.write_block_frames(
+                                block, f, codec, self.window,
+                                at_least_one=True)
+                            f.flush()
+                            os.fsync(f.fileno())
+                        os.replace(tmp, final)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
+
             try:
                 t0 = time.perf_counter()
-                with _trace.span("spill", "spill-write", bytes=nbytes,
-                                 records=len(block)):
-                    with open(tmp, "wb") as f:
-                        frames.write_block_frames(
-                            block, f, codec, self.window, at_least_one=True)
-                        f.flush()
-                        os.fsync(f.fileno())
-                    os.replace(tmp, final)
+                _faults.retry_io(write_once, "spill_write")
                 secs = time.perf_counter() - t0
                 try:
                     disk_bytes = os.path.getsize(final)
@@ -208,10 +228,18 @@ class SpillWriterPool(object):
 
     def close(self):
         """Stop the worker threads (used by store cleanup; queued writes
-        are aborted first)."""
+        are aborted first).  A worker that fails to stop inside the join
+        deadline is named loudly — silent thread leaks at shutdown hide
+        wedged codecs/disks (the threads are daemons, so interpreter
+        exit is never blocked either way)."""
         self.abort()
         for _ in self._threads:
             self._q.put(_STOP)
         for t in self._threads:
             t.join(timeout=5.0)
+            if t.is_alive():
+                log.warning(
+                    "spill writer thread %s did not stop within 5.0s at "
+                    "shutdown; abandoning it (daemon) — a wedged codec "
+                    "or disk write is still in flight", t.name)
         self._threads = []

@@ -136,6 +136,50 @@ _PLAYBOOK = {
          "fewer, larger collective steps amortize the per-step entry "
          "spread when skew is jitter rather than a persistent straggler"),
     ],
+    "fault-retry": [
+        ("job_retries", "DAMPR_TPU_JOB_RETRIES",
+         lambda cur: max(3, int(cur or 0)),
+         "jobs failed and re-executed — a deeper retry budget absorbs "
+         "longer flaky-IO bursts (transient failures back off with "
+         "jitter between attempts)"),
+        ("retry_backoff_ms", "DAMPR_TPU_RETRY_BACKOFF_MS",
+         lambda cur: max(100, int(cur or 0) * 2),
+         "a retry STORM (many retries, little progress) wants a longer "
+         "backoff base so attempts decorrelate from the failing "
+         "resource's recovery window"),
+        ("io_retries", "DAMPR_TPU_IO_RETRIES",
+         lambda cur: max(4, int(cur or 0) * 2),
+         "transient spill-IO failures are absorbed inside the IO layer "
+         "— a deeper in-place budget keeps them from surfacing as job "
+         "failures at all"),
+    ],
+    "quarantine": [
+        ("max_quarantined", "DAMPR_TPU_MAX_QUARANTINED",
+         lambda cur: None,
+         "poison records were skipped into the quarantine sink — raise "
+         "the budget if more are expected, or set 0 to fail fast and "
+         "fix the data; the sink file lists every skipped record"),
+        ("job_retries", "DAMPR_TPU_JOB_RETRIES",
+         lambda cur: None,
+         "deterministic record failures are NOT healed by retries — "
+         "inspect quarantine.jsonl and fix the records or the UDF"),
+    ],
+    "exchange-timeout": [
+        ("exchange_timeout_ms", "DAMPR_TPU_EXCHANGE_TIMEOUT_MS",
+         lambda cur: max(60000, int(cur or 0) * 2),
+         "a collective exchange step hit its deadline and the run "
+         "aborted — raise the deadline if the fleet was merely slow; "
+         "the shuffle stays degraded to host until faults.jsonl is "
+         "cleared"),
+        ("exchange_hbm_budget", "DAMPR_TPU_EXCHANGE_HBM",
+         lambda cur: max(64 * 1024 ** 2, int(cur or 0) * 2),
+         "fewer, larger collective steps shrink the window in which a "
+         "rank death can strand a step (and amortize per-step cost)"),
+        ("mesh_exchange", "DAMPR_TPU_MESH_EXCHANGE",
+         lambda cur: "off",
+         "or pin every redistribution to the host shuffle while the "
+         "fleet is unstable"),
+    ],
     "mesh": [
         ("exchange_hbm_budget", "DAMPR_TPU_EXCHANGE_HBM",
          lambda cur: max(64 * 1024 ** 2, int(cur or 0) * 2),
@@ -452,9 +496,91 @@ def diagnose(run):
                 "suggestions": [],
             })
 
+    # -- failure-recovery signals (dampr_tpu.faults) -------------------------
+    from .. import faults as _faults_mod
+
+    fa = summary.get("faults") or {}
+    events = _faults_mod.load_events(summary.get("run"))
+    timeouts = [ev for ev in events if ev.get("kind") == "exchange_timeout"]
+    retries = fa.get("retries") or 0
+    quarantined = fa.get("quarantined") or 0
+    backoff_s = fa.get("backoff_seconds") or 0.0
+    if retries:
+        sec = min(backoff_s, wall) if wall > 0 else backoff_s
+        io_r = fa.get("io_retries") or {}
+        findings.append({
+            "stage": None,
+            "bottleneck": "fault-retry",
+            "impact_seconds": round(sec, 4),
+            "severity": _severity(sec, wall) if sec else "low",
+            "evidence": "{} classified retries absorbed ({} job "
+                        "re-execution(s), {} in-place IO retr{}), "
+                        "{:.2f}s spent backing off".format(
+                            retries, fa.get("job_retries") or 0,
+                            sum(io_r.values()),
+                            "y" if sum(io_r.values()) == 1 else "ies",
+                            backoff_s),
+            "suggestions": _suggestions_for("fault-retry", summary,
+                                            run_settings=run_settings),
+        })
+    if quarantined:
+        findings.append({
+            "stage": None,
+            "bottleneck": "quarantine",
+            "impact_seconds": 0.0,
+            "severity": "medium",
+            "evidence": "{} poison record(s) quarantined (budget "
+                        "max_quarantined={}) — the stage completed "
+                        "without them; inspect {}".format(
+                            quarantined, fa.get("max_quarantined"),
+                            fa.get("quarantine_file")
+                            or "the quarantine sink"),
+            "suggestions": _suggestions_for("quarantine", summary,
+                                            run_settings=run_settings),
+        })
+    if timeouts:
+        stages_to = sorted({ev.get("stage") for ev in timeouts
+                            if ev.get("stage") is not None})
+        findings.append({
+            "stage": stages_to[0] if len(stages_to) == 1 else None,
+            "bottleneck": "exchange-timeout",
+            "impact_seconds": 0.0,
+            "severity": "high",
+            "evidence": "{} recorded collective exchange timeout(s)"
+                        "{} — surviving ranks aborted with crashdumps; "
+                        "affected stages stay degraded to the host "
+                        "shuffle until faults.jsonl is cleared".format(
+                            len(timeouts),
+                            " at stage(s) {}".format(stages_to)
+                            if stages_to else ""),
+            "suggestions": _suggestions_for("exchange-timeout", summary,
+                                            run_settings=run_settings),
+        })
+
     findings.sort(key=lambda f: -(f.get("impact_seconds") or 0.0))
     for rank, f in enumerate(findings, 1):
         f["rank"] = rank
+
+    fault_section = None
+    if fa or events:
+        fault_section = {
+            "enabled": bool(fa.get("enabled")),
+            "retries": retries,
+            "job_retries": fa.get("job_retries") or 0,
+            "io_retries": fa.get("io_retries") or {},
+            "backoff_seconds": backoff_s,
+            "quarantined": quarantined,
+            "exchange_timeouts": len(timeouts),
+        }
+        if fa.get("max_quarantined") is not None:
+            fault_section["max_quarantined"] = fa["max_quarantined"]
+        if fa.get("quarantine_file"):
+            fault_section["quarantine_file"] = fa["quarantine_file"]
+        if fa.get("plan"):
+            fault_section["plan"] = fa["plan"]
+            fault_section["injected"] = fa.get("injected") or {}
+        if events:
+            fault_section["events"] = events[-10:]
 
     report = {
         "schema": SCHEMA,
@@ -469,6 +595,8 @@ def diagnose(run):
     }
     if fleet_report is not None:
         report["fleet"] = fleet_report
+    if fault_section is not None:
+        report["faults"] = fault_section
     return report
 
 
@@ -543,8 +671,10 @@ def diff(run_a, run_b):
     }
 
 
-def format_report(report):
-    """Human-readable rendering."""
+def format_report(report, show_faults=False):
+    """Human-readable rendering.  ``show_faults`` (the ``--faults``
+    flag) adds the failure-recovery section: classified retry counts,
+    quarantine state, injection plan, and recorded exchange timeouts."""
     lines = []
     add = lines.append
     d = report.get("diff")
@@ -609,6 +739,33 @@ def format_report(report):
                 if e.get("wall_seconds") is not None else "-",
                 "{:.1f}MB".format((e.get("spill_bytes") or 0) / 1e6),
                 e.get("verdict") or "?"))
+    if show_faults:
+        fa = report.get("faults")
+        if not fa:
+            add("faults: nothing recorded (run predates the fault "
+                "section, or stats.json is missing it)")
+        else:
+            io_r = fa.get("io_retries") or {}
+            add("faults: {} retr{} ({} job re-execution(s), {} IO) · "
+                "backoff {:.2f}s · quarantined {}{}".format(
+                    fa.get("retries") or 0,
+                    "y" if (fa.get("retries") or 0) == 1 else "ies",
+                    fa.get("job_retries") or 0, sum(io_r.values()),
+                    fa.get("backoff_seconds") or 0.0,
+                    fa.get("quarantined") or 0,
+                    "/{}".format(fa["max_quarantined"])
+                    if fa.get("max_quarantined") is not None else ""))
+            if fa.get("plan"):
+                add("  injection plan: {!r} · injected: {}".format(
+                    fa["plan"], fa.get("injected") or {}))
+            if fa.get("quarantine_file"):
+                add("  quarantine sink: {}".format(fa["quarantine_file"]))
+            if fa.get("exchange_timeouts"):
+                add("  exchange timeouts recorded: {} (stages degraded "
+                    "to the host shuffle until faults.jsonl is "
+                    "cleared)".format(fa["exchange_timeouts"]))
+            for ev in fa.get("events") or ():
+                add("  event: {}".format(json.dumps(ev, sort_keys=True)))
     if not report.get("findings"):
         add("no findings: nothing instrumented dominates — this run "
             "looks healthy at the recorded granularity")
@@ -645,6 +802,10 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report "
                          "(docs/doctor_schema.json)")
+    ap.add_argument("--faults", action="store_true",
+                    help="render the failure-recovery section: "
+                         "classified retries, quarantine state, "
+                         "injection plan, recorded exchange timeouts")
     args = ap.parse_args(argv)
 
     try:
@@ -662,7 +823,7 @@ def main(argv=None):
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
-        print(format_report(report))
+        print(format_report(report, show_faults=args.faults))
     # A crashed run is a diagnosis, not a doctor failure — but scripts
     # should see it (same convention as dampr-tpu-stats).
     return 3 if report.get("crashed") else 0

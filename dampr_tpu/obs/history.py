@@ -52,7 +52,8 @@ _KNOBS = ("partitions", "batch_size", "max_memory_per_stage",
           "overlap_windows", "spill_write_threads", "spill_read_prefetch",
           "merge_fanin", "max_processes", "optimize", "profile",
           "mesh_exchange", "exchange_hbm_budget", "exchange_chunk_bytes",
-          "exchange_min_bytes")
+          "exchange_min_bytes", "job_retries", "io_retries",
+          "retry_backoff_ms", "max_quarantined", "exchange_timeout_ms")
 
 
 def corpus_path(run_name):

@@ -21,9 +21,12 @@ state, and its thread is a daemon — a wedged write can't hold a run's
 teardown hostage.
 """
 
+import logging
 import sys
 import threading
 import time
+
+log = logging.getLogger("dampr_tpu.obs.progress")
 
 
 def _fmt_count(n):
@@ -79,6 +82,11 @@ class ProgressReporter(object):
         if t is not None:
             t.join(timeout=2.0)
             self._thread = None
+            if t.is_alive():
+                log.warning(
+                    "progress reporter thread %s did not stop within "
+                    "2.0s at shutdown; abandoning it (daemon) — a "
+                    "wedged stream write is still in flight", t.name)
         if self._wrote_inline:
             try:
                 self.stream.write("\n")
@@ -145,6 +153,9 @@ class ProgressReporter(object):
     def _loop(self):
         while not self._stop.wait(self.interval):
             try:
+                from .. import faults as _faults
+
+                _faults.check("progress_tick")  # slow-stop tests
                 self._tick()
             except Exception:
                 pass

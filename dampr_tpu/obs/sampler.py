@@ -56,6 +56,11 @@ class Sampler(object):
         if t is not None:
             t.join(timeout=2.0)
             self._thread = None
+            if t.is_alive():
+                log.warning(
+                    "metrics sampler thread %s did not stop within "
+                    "2.0s at shutdown; abandoning it (daemon) — a "
+                    "wedged gauge callback is still sampling", t.name)
         if final_sample:
             try:
                 self._sample_once()
@@ -90,6 +95,9 @@ class Sampler(object):
         next_at = time.perf_counter()
         while not self._stop.is_set():
             try:
+                from .. import faults as _faults
+
+                _faults.check("sampler_tick")  # slow-stop shutdown tests
                 self._sample_once()
             except Exception:
                 # A broken gauge must degrade observability, not the run.

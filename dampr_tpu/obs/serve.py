@@ -147,6 +147,12 @@ class MetricsServer(object):
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=2.0)
+            if t.is_alive():
+                log.warning(
+                    "metrics endpoint thread %s did not stop within "
+                    "2.0s at shutdown; abandoning it (daemon) — a "
+                    "wedged in-flight request is still being served",
+                    t.name)
 
 
 def start_server(port, run_name=None):

@@ -335,6 +335,12 @@ class DeviceTokenFoldSink(object):
         (padded-matrix construction, host) and ``h2d`` (program dispatch
         + argument feed) here, ``compute``/``d2h`` at drain."""
         n = len(starts)
+        from .. import faults as _faults
+
+        # Fault site: a classified failure here surfaces through the map
+        # job and rides the job retry loop (the whole-chunk fallback
+        # paths keep results byte-identical on re-execution).
+        _faults.check("device_dispatch")
         prof = _profile.active()
         t0p = time.perf_counter() if prof is not None else 0.0
         with devtime.track("codec"):

@@ -40,13 +40,19 @@ always fits by construction.
 """
 
 import functools
+import logging
+import os
 import pickle
+import threading
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import settings
 from . import replan
 from .mesh import mesh_size, shard_map as _shard_map
+
+log = logging.getLogger("dampr_tpu.parallel.exchange")
 
 
 @functools.lru_cache(maxsize=None)
@@ -94,6 +100,45 @@ def _build_exchange(mesh, axis, capacity, gather=False):
 #: per-run ``stats()["mesh"]["exchange"]`` section; the multichip dryrun
 #: prints them per device.
 last_info = None
+
+#: Process-cumulative exchange-timeout near-misses (steps that finished
+#: but only after the watchdog was armed).  Purely observational.
+watchdogs_armed = 0
+
+
+def _step_watchdog(step_i, timeout_ms):
+    """Bounded deadline for one collective step: a dead rank wedges a
+    gloo collective FOREVER — no Python-level interrupt can break the
+    native call — so the only clean abort for the surviving ranks is to
+    flush their flight recorders (schema-valid crashdump per rank),
+    record the timeout in the run's fault-event sidecar (the next run's
+    shuffle routing degrades this stage to the host path), and exit the
+    process nonzero.  Returns the event the step sets on completion."""
+    done = threading.Event()
+    ctx = dict(_faults.run_context)
+
+    def expire():
+        if done.wait(timeout_ms / 1000.0):
+            return
+        from ..obs import flightrec as _flightrec
+
+        exc = TimeoutError(
+            "collective exchange step {} exceeded "
+            "exchange_timeout_ms={} — a peer rank is dead or wedged; "
+            "aborting this rank rather than hanging the gloo "
+            "collective".format(step_i, timeout_ms))
+        log.error("%s (run=%r stage=%r)", exc, ctx.get("run"),
+                  ctx.get("stage"))
+        _flightrec.flush_active("exchange-timeout", exc)
+        _faults.record_event(
+            ctx.get("run"), "exchange_timeout", stage=ctx.get("stage"),
+            step=step_i, timeout_ms=timeout_ms)
+        os._exit(70)  # EX_SOFTWARE: bounded abort, never a hang
+
+    t = threading.Thread(target=expire, daemon=True,
+                         name="dampr-exchange-watchdog")
+    t.start()
+    return done
 
 
 def mesh_blob_exchange(mesh, blobs, budget=None):
@@ -145,12 +190,28 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
                     sent[s] += n
         prog = _build_exchange(mesh, settings.mesh_axis, step.capacity,
                                gather=gather)
-        with _trace.span("exchange", "step:{}".format(i), step=i,
-                         bytes=int(step.payload_bytes()),
-                         capacity=int(step.capacity),
-                         inflight_bytes=int(step.inflight_bytes)):
-            rb, rl = prog(buf, lens)
-            rb.block_until_ready()
+        # Fault sites: ``rank_kill`` (exit action — the multi-process
+        # chaos tests kill one rank mid-exchange here, precisely where a
+        # real dead rank would leave its peers hanging) and
+        # ``exchange_step`` (classified failures on the step itself).
+        _faults.check("rank_kill")
+        _faults.check("exchange_step")
+        timeout_ms = settings.exchange_timeout_ms
+        guard = None
+        if timeout_ms > 0:
+            global watchdogs_armed
+            watchdogs_armed += 1
+            guard = _step_watchdog(i, timeout_ms)
+        try:
+            with _trace.span("exchange", "step:{}".format(i), step=i,
+                             bytes=int(step.payload_bytes()),
+                             capacity=int(step.capacity),
+                             inflight_bytes=int(step.inflight_bytes)):
+                rb, rl = prog(buf, lens)
+                rb.block_until_ready()
+        finally:
+            if guard is not None:
+                guard.set()
         with _trace.span("exchange", "d2h:{}".format(i), step=i):
             rb = np.asarray(rb)
             rl = np.asarray(rl)

@@ -277,6 +277,30 @@ def apply_shuffle(runner, report):
     decisions = shuffle_analyze(
         graph, history, n_dev if n_dev is not None else 2,
         getattr(runner, "n_partitions", settings.partitions), device_sids)
+    # Fault-history degrade: a stage whose collective exchange TIMED OUT
+    # in a previous run under this name (a dead rank wedged the gloo
+    # collective; the watchdog recorded the event before aborting) pins
+    # to the host shuffle — a hung collective is catastrophic, so
+    # host-until-the-operator-clears-it is the safe direction.  Explicit
+    # ``mesh_exchange="on"`` still wins (the operator asked).
+    if mode not in ("on", "1", "true"):
+        try:
+            from .. import faults as _faults
+
+            timed_out = _faults.stages_with_exchange_timeouts(
+                getattr(runner, "name", None))
+        except Exception:
+            timed_out = ()
+        for d in decisions:
+            if d["target"] == "mesh" and d["sid"] in timed_out:
+                d["target"] = "host"
+                d["reason"] = (
+                    "fault-history: a previous run's collective exchange "
+                    "timed out at this stage (exchange_timeout_ms) — "
+                    "degraded to the host shuffle; delete the run's "
+                    "faults.jsonl to re-try the mesh")
+                log.warning("plan: stage %d shuffle degraded to host "
+                            "after a recorded exchange timeout", d["sid"])
     section["enabled"] = True
     section["targets"] = decisions
     section["mesh_stages"] = sum(

@@ -430,7 +430,15 @@ def _ensure_on_disk(ref, directory, pool=None):
                         _spill_codec(ref.key_dtype, ref.value_dtype),
                         clear_block=False)
         else:
-            save_block(blk, path)
+            from . import faults as _faults
+
+            def write_once():
+                _faults.check("checkpoint_persist")
+                save_block(blk, path)
+
+            # Transient-retry like every other spill write ("wb"
+            # truncates: idempotent).
+            _faults.retry_io(write_once, "checkpoint_persist")
             ref.path = path
         return path, blk.nbytes()
     return ref.path, ref.nbytes
@@ -481,9 +489,21 @@ def persist_stage(store, sid, fp, result, nrec):
     old_paths = _manifest_files(root, sid)
     os.makedirs(_manifest_dir(root), exist_ok=True)
     tmp = _manifest_path(root, sid) + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f)
-    os.replace(tmp, _manifest_path(root, sid))
+
+    def write_manifest():
+        from . import faults as _faults
+
+        _faults.check("checkpoint_persist")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, _manifest_path(root, sid))
+
+    from . import faults as _faults
+
+    # tmp -> atomic replace: a transient failure (or injected
+    # ``checkpoint_persist`` fault) retries in place; a crash between
+    # retries leaves the previous manifest restorable, never a dangler.
+    _faults.retry_io(write_manifest, "checkpoint_persist")
     _prune(root, old_paths)
     _trace.complete("checkpoint", "persist", _t0, stage=sid,
                     records=nrec, kind=manifest["kind"])

@@ -35,7 +35,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from . import base, settings, storage
+from . import base, faults as _faults, settings, storage
 from .blocks import Block, BlockBuilder
 from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
 from .graph import GInput, GMap, GReduce, GSink
@@ -157,6 +157,10 @@ def _overlap_stream(items, store, size_of=None):
     def produce():
         try:
             for item in items:
+                # Fault site: chaos tests widen the producer/consumer
+                # shutdown race here (sleep action) to prove reservation
+                # accounting survives a consumer that dies mid-run.
+                _faults.check("overlap_produce")
                 if stop.is_set():
                     return
                 if item is None:
@@ -261,9 +265,33 @@ def _overlap_stream(items, store, size_of=None):
 
             drain()
             thread.join(timeout=5.0)
+            if thread.is_alive():
+                # A producer stuck inside the native codec (or a wedged
+                # disk under it) past the join deadline: name it loudly
+                # instead of silently abandoning the join result, and
+                # keep draining briefly — the producer releases its own
+                # reservation when it observes ``stop``, but an item it
+                # slips into the queue after our drain would otherwise
+                # leak its budget charge until process exit.
+                log.warning(
+                    "overlap producer thread %s did not stop within "
+                    "5.0s at shutdown; draining in-flight windows in "
+                    "the background (daemon thread abandoned)",
+                    thread.name)
+                deadline = time.perf_counter() + 5.0
+                while thread.is_alive() and time.perf_counter() < deadline:
+                    drain()
+                    thread.join(timeout=0.05)
+                if thread.is_alive():
+                    log.warning(
+                        "overlap producer thread %s still alive after "
+                        "drain grace; any window it produces past this "
+                        "point leaks its budget reservation until the "
+                        "store is cleaned up", thread.name)
             # The producer may have slipped one reserved block into the
             # slot the first drain freed before it observed ``stop`` —
-            # with the thread joined, a second drain is conclusive.
+            # with the thread joined (or the grace above spent), a final
+            # drain is conclusive.
             drain()
 
     return gen()
@@ -693,8 +721,8 @@ class StageStats(object):
 
     __slots__ = ("stage_id", "kind", "n_jobs", "records_in", "records_out",
                  "bytes_in", "bytes_out", "spill_count", "spill_bytes",
-                 "merge_gens", "merge_gen_bytes", "retries", "seconds",
-                 "target", "shuffle_target")
+                 "merge_gens", "merge_gen_bytes", "retries", "quarantined",
+                 "seconds", "target", "shuffle_target")
 
     def __init__(self, stage_id, kind):
         self.stage_id = stage_id
@@ -717,6 +745,9 @@ class StageStats(object):
         self.merge_gens = 0
         self.merge_gen_bytes = 0
         self.retries = 0
+        # Poison records this stage skipped into the quarantine sink
+        # (settings.max_quarantined; see dampr_tpu.faults.Quarantine).
+        self.quarantined = 0
         self.seconds = 0.0
 
     def as_dict(self):
@@ -731,6 +762,7 @@ class StageStats(object):
                 "merge_gens": self.merge_gens,
                 "merge_gen_bytes": self.merge_gen_bytes,
                 "retries": self.retries,
+                "quarantined": self.quarantined,
                 "shuffle_target": self.shuffle_target,
                 "seconds": round(self.seconds, 4)}
 
@@ -768,6 +800,17 @@ class MTRunner(object):
         self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
         self.retries_total = 0  # transient-failure job re-executions
         self._retry_lock = threading.Lock()
+        self._backoff_seconds = 0.0  # classified-retry sleep total
+        # Poison-record quarantine sink (settings.max_quarantined > 0):
+        # deterministically-failing records on the batched-UDF path are
+        # bisected out into <scratch>/<run>/quarantine.jsonl and the
+        # stage completes; 0 keeps fail-fast.
+        self._quarantine = (_faults.Quarantine(name,
+                                               settings.max_quarantined)
+                            if settings.max_quarantined > 0 else None)
+        # Fault-injection counter epoch (process-cumulative counters;
+        # finalize reports this run's deltas in stats()["faults"]).
+        self._fault_snapshot = None
         # Run-scoped observability (dampr_tpu.obs): the tracer is live only
         # while settings.trace is on; run_summary (the stats.json dict) is
         # built for every run — it is how StageStats reaches users.
@@ -811,16 +854,30 @@ class MTRunner(object):
                         # refs against the memory budget.
                         with self.store.attempt():
                             return inner(job)
-                    except Exception:
-                        if attempt == retries:
+                    except Exception as e:
+                        # Classified retry (dampr_tpu.faults): fatal
+                        # failures never re-execute; transient ones back
+                        # off exponentially with jitter so a retry storm
+                        # against a sick disk decorrelates; deterministic
+                        # failures retry immediately (a stateful UDF may
+                        # recover — the historical contract).
+                        kind = _faults.classify(e)
+                        if kind == "fatal" or attempt == retries:
                             raise
+                        delay = (_faults.backoff(attempt)
+                                 if kind == "transient" else 0.0)
                         with self._retry_lock:
                             self.retries_total += 1
+                            self._backoff_seconds += delay
                         _trace.instant("retry", label or "job",
-                                       attempt=attempt + 1)
+                                       attempt=attempt + 1, kind=kind)
                         log.warning(
-                            "job failed (attempt %d/%d), retrying",
-                            attempt + 1, retries + 1, exc_info=True)
+                            "job failed (%s, attempt %d/%d), retrying"
+                            "%s", kind, attempt + 1, retries + 1,
+                            " in %.0f ms" % (delay * 1000) if delay
+                            else "", exc_info=True)
+                        if delay:
+                            time.sleep(delay)
 
         if label is not None and _trace.enabled():
             traced = fn
@@ -1245,6 +1302,7 @@ class MTRunner(object):
                 if blk is None or not len(blk):
                     return
                 if combine_op is not None:
+                    _faults.check("fold")
                     prof = _profile.active()
                     t0p = time.perf_counter() if prof is not None else 0.0
                     with _trace.span("fold", "partial-fold",
@@ -1322,6 +1380,12 @@ class MTRunner(object):
         def job(chunk):
             mapper = _clone_op(stage.mapper)
             builder = BlockBuilder(stage_batch)
+            # Attempt-scoped quarantine recorder: records isolated by
+            # this attempt's bisect land in the global sink only when
+            # the attempt SUCCEEDS (commit below), so a retried job
+            # never double-counts and genuine duplicates each count.
+            quarantine = self._quarantine
+            qrec = quarantine.attempt() if quarantine is not None else None
             # Vectorized block protocol: mappers exposing map_blocks consume
             # the chunk's raw bytes and emit whole Blocks, skipping the
             # per-record Python path entirely (the SURVEY §7 dual-path).
@@ -1411,7 +1475,7 @@ class MTRunner(object):
                         del pk[:B]
                         del pv[:B]
 
-                def run_chain(ks, vs, start):
+                def run_chain(ks, vs, start, emit_fn):
                     for i in range(start, len(chain)):
                         op = chain[i]
                         if type(op) is base.FlatMap and len(ks) > 1024:
@@ -1436,7 +1500,7 @@ class MTRunner(object):
                                 if sks:
                                     fan = -(-len(sks) // took)
                                     step = max(64, min(B, B // fan))
-                                    run_chain(sks, svs, i + 1)
+                                    run_chain(sks, svs, i + 1, emit_fn)
                             return
                         if prof is None:
                             ks, vs = op.apply_batch(ks, vs)
@@ -1451,10 +1515,57 @@ class MTRunner(object):
                                         records=len(ks))
                         if not ks:
                             return
-                    emit(ks, vs)
+                    emit_fn(ks, vs)
 
-                for ks, vs in batches:
-                    run_chain(ks, vs, 0)
+                fa = _faults.active()
+                if quarantine is None and fa is None:
+                    # The hot default: straight through, zero added cost.
+                    for ks, vs in batches:
+                        run_chain(ks, vs, 0, emit)
+                else:
+                    # Poison-record quarantine (and/or fault injection):
+                    # each input batch runs TRANSACTIONALLY — outputs
+                    # stage into a local buffer and only merge into the
+                    # block builder on success, so a deterministic
+                    # failure mid-chain (or mid-FlatMap-slice) can be
+                    # bisected and re-run without duplicating records.
+                    # Order is preserved (left half before right half),
+                    # so results are byte-identical to a run whose input
+                    # simply lacked the quarantined records.
+                    def guarded_run(ks, vs):
+                        staged = []
+
+                        def stage_emit(sks, svs):
+                            staged.append((sks, svs))
+
+                        try:
+                            if fa is not None:
+                                _faults.check_records("udf", ks, vs)
+                            run_chain(ks, vs, 0,
+                                      stage_emit if quarantine is not None
+                                      else emit)
+                        except Exception as e:
+                            if (quarantine is None
+                                    or _faults.classify(e)
+                                    != "deterministic"):
+                                raise
+                            if len(ks) <= 1:
+                                qrec.add(
+                                    _faults.run_context.get("stage"),
+                                    ks[0] if ks else None,
+                                    vs[0] if vs else None, e)
+                                return
+                            with _trace.span("fault", "quarantine-bisect",
+                                             records=len(ks)):
+                                mid = len(ks) // 2
+                                guarded_run(ks[:mid], vs[:mid])
+                                guarded_run(ks[mid:], vs[mid:])
+                            return
+                        for sks, svs in staged:
+                            emit(sks, svs)
+
+                    for ks, vs in batches:
+                        guarded_run(ks, vs)
                 if pk:
                     push(Block.from_lists(pk, pv))
             else:
@@ -1476,7 +1587,10 @@ class MTRunner(object):
                     for k, v in kvs:
                         push(builder.add(k, v))
                     push(builder.flush())
-            return end()
+            out = end()
+            if qrec is not None:
+                qrec.commit()
+            return out
 
         return (job, combine_op, pin, feeds_reduce, new_sink,
                 feeds_device_fold, sorted_run_mode, window_sink)
@@ -2392,6 +2506,33 @@ class MTRunner(object):
             self._metrics_server.stop()
             self._metrics_server = None
 
+    def _install_sigterm(self):
+        """Raise-on-SIGTERM while a run is in flight, so an external kill
+        walks the same BaseException path as KeyboardInterrupt — flight
+        recorder flush, spill-writer abort, nonzero exit — instead of
+        dying with no crash artifact.  Only from the main thread (signal
+        API constraint) and only when no application handler is already
+        installed; returns a restore closure."""
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+            if prev is not signal.SIG_DFL:
+                # The application owns SIGTERM (a Python handler, SIG_IGN,
+                # or — getsignal() returning None — a handler installed
+                # by non-Python code): never clobber it.
+                return None
+
+            def _on_term(signum, frame):
+                raise SystemExit(143)  # 128 + SIGTERM, shell convention
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            return None
+        return lambda: signal.signal(signal.SIGTERM, prev)
+
     def run(self, outputs, cleanup=True):
         from . import plan as _plan
         from .ops import devtime
@@ -2401,6 +2542,13 @@ class MTRunner(object):
         # the report records either way).  Before obs setup: stage counts
         # and resume fingerprints must see the final graph.
         _plan.apply_to_runner(self, outputs)
+        # Fault plan (settings.faults): a fresh per-run schedule so chaos
+        # runs replay identically; the counter epoch scopes the
+        # stats()["faults"] section to THIS run.
+        _faults.configure_for_run()
+        self._fault_snapshot = _faults.counters_snapshot()
+        _faults.set_context(run=self.name)
+        restore_sigterm = self._install_sigterm()
         wall_start = time.time()
         epoch = devtime.epoch()
         rec = self._start_obs()
@@ -2425,6 +2573,12 @@ class MTRunner(object):
                 rec.flush("run-failed", e)
             raise
         finally:
+            if restore_sigterm is not None:
+                try:
+                    restore_sigterm()
+                except (ValueError, OSError):
+                    pass
+            _faults.set_context(run=None, stage=None)
             self._stop_obs()
             try:
                 # Built on failure too: a partial timeline + stage stats
@@ -2467,6 +2621,32 @@ class MTRunner(object):
             # JSON-safe route triples [src_device, dst_device, bytes]
             "routes": [[s, d, n] for (s, d), n in sorted(pair.items())],
         }
+
+    def _faults_section(self):
+        """The per-run ``stats()["faults"]`` payload: this run's share of
+        the process-cumulative retry/injection counters, plus quarantine
+        and backoff totals."""
+        injected, io_retries, io_backoff = _faults.counters_delta(
+            self._fault_snapshot)
+        q = self._quarantine
+        plan = _faults.active()
+        section = {
+            "enabled": plan is not None,
+            "job_retries": self.retries_total,
+            "io_retries": dict(io_retries),
+            "retries": self.retries_total + sum(io_retries.values()),
+            # Job-loop backoff plus the IO layer's in-place retry sleeps
+            # — an IO-only retry storm must show its cost here.
+            "backoff_seconds": round(self._backoff_seconds + io_backoff, 4),
+            "quarantined": q.count if q is not None else 0,
+            "max_quarantined": settings.max_quarantined,
+        }
+        if q is not None and q.count:
+            section["quarantine_file"] = q.path
+        if plan is not None:
+            section["plan"] = plan.spec
+            section["injected"] = dict(injected)
+        return section
 
     def _finalize_obs(self, wall_start, wall, dev):
         """Build the per-run summary (the stats.json payload) and, when
@@ -2589,6 +2769,12 @@ class MTRunner(object):
             },
             "streamed_assoc_folds": self.streamed_assoc_folds,
             "retries": self.retries_total,
+            # Failure-recovery summary (dampr_tpu.faults): classified
+            # retries absorbed at every layer (job re-executions + the IO
+            # layer's in-place transient retries), quarantine state, and
+            # injection counts when a chaos plan was active.  "retries"
+            # is the headline total the chaos gates assert on.
+            "faults": self._faults_section(),
             # The logical plan that executed: stages before/after the
             # optimizer, rules fired, adaptive sizing decisions, and the
             # stage shapes the NEXT run's cost layer matches against.
@@ -2716,8 +2902,10 @@ class MTRunner(object):
         """Store/retry counters at a stage boundary; the per-stage deltas
         become that stage's StageStats pressure fields."""
         sto = self.store
+        q = self._quarantine
         return (sto.spill_count, sto.spilled_bytes, sto.merge_gens,
-                sto.merge_gen_bytes, self.retries_total)
+                sto.merge_gen_bytes, self.retries_total,
+                q.count if q is not None else 0)
 
     def _fill_stage_io(self, st, stage, env, result, snap):
         for s in getattr(stage, "inputs", ()):
@@ -2735,6 +2923,8 @@ class MTRunner(object):
         st.merge_gens = sto.merge_gens - snap[2]
         st.merge_gen_bytes = sto.merge_gen_bytes - snap[3]
         st.retries = self.retries_total - snap[4]
+        q = self._quarantine
+        st.quarantined = (q.count - snap[5]) if q is not None else 0
 
     def _run_stages(self, outputs, cleanup):
         rep = self.plan_report
@@ -2787,6 +2977,10 @@ class MTRunner(object):
             t0_span = _trace.now()
             snap = self._pressure_snap()
             self.store.set_stage(sid)
+            # Fault attribution context: the stage the exchange watchdog
+            # and quarantine sink tag their events with (sequential
+            # walk: single writer).
+            _faults.set_context(run=self.name, stage=sid)
             if isinstance(stage, GInput):
                 env[stage.output] = stage.tap
                 continue

@@ -457,8 +457,60 @@ scratch_root = os.environ.get("DAMPR_TPU_SCRATCH", "/tmp/dampr_tpu")
 #: Per-job retry budget for transient failures (flaky IO/UDF): a failing map/
 #: reduce/sink job re-executes up to this many times before the run fails
 #: fast with the original traceback.  The reference deadlocks on a dead
-#: worker (stagerunner.py:35-38); 0 keeps plain fail-fast.
-job_retries = 0
+#: worker (stagerunner.py:35-38); 0 keeps plain fail-fast.  Retries are
+#: CLASSIFIED (dampr_tpu.faults.classify): transient failures (flaky IO)
+#: back off exponentially with jitter between attempts; deterministic
+#: failures retry immediately (legacy behavior — a stateful UDF may
+#: recover); fatal failures (MemoryError, kills) never retry.
+job_retries = int(os.environ.get("DAMPR_TPU_JOB_RETRIES", "0"))
+
+#: In-place retry budget for transient spill IO (background/sync frame
+#: writes, frame reads, checkpoint persistence).  These retries are
+#: absorbed inside the IO layer — a flaky disk never surfaces as a job
+#: failure unless the budget is exhausted.  Counted in
+#: ``stats()["faults"]``.
+io_retries = int(os.environ.get("DAMPR_TPU_IO_RETRIES", "2"))
+
+#: Exponential-backoff base and cap (milliseconds) for classified
+#: transient retries (full jitter: each delay is uniform over
+#: [0, min(cap, base * 2^attempt)]).
+retry_backoff_ms = int(os.environ.get("DAMPR_TPU_RETRY_BACKOFF_MS", "50"))
+retry_backoff_max_ms = int(os.environ.get(
+    "DAMPR_TPU_RETRY_BACKOFF_MAX_MS", "5000"))
+
+#: Poison-record quarantine budget: when > 0, a deterministically-failing
+#: record batch on the batched-UDF map path is bisected and up to this
+#: many offending records land in the run's quarantine sink
+#: (``<scratch_root>/<run>/quarantine.jsonl``) instead of failing the
+#: run; the stage completes with the skip count in
+#: ``stats()["faults"]["quarantined"]`` and per-stage ``quarantined``
+#: counters.  0 (default) = fail fast as before.
+max_quarantined = int(os.environ.get("DAMPR_TPU_MAX_QUARANTINED", "0"))
+
+#: Bounded deadline (milliseconds) for each collective exchange step
+#: (``parallel.exchange.mesh_blob_exchange``).  0 (default) = no
+#: watchdog.  When set, a step that has not completed within the
+#: deadline — a dead rank wedging the gloo collective — makes every
+#: SURVIVING rank abort cleanly: the flight recorder flushes a
+#: crashdump, the timeout is recorded in the run's fault-event sidecar
+#: (so the next run's shuffle routing degrades that stage to the host
+#: path), and the process exits nonzero instead of hanging forever.
+exchange_timeout_ms = int(os.environ.get(
+    "DAMPR_TPU_EXCHANGE_TIMEOUT_MS", "0"))
+
+#: Whole-run retry budget for ``run(resume="auto")``: a failed run
+#: re-executes from its last durable checkpoint manifest up to this
+#: many times (transient-backoff between attempts; fatal failures and
+#: explicit kills never auto-resume).
+run_retries = int(os.environ.get("DAMPR_TPU_RUN_RETRIES", "1"))
+
+#: Deterministic fault-injection plan (dampr_tpu.faults): a seeded,
+#: schedule-based spec naming fault sites and firing rules, e.g.
+#: ``"spill_write:p=0.01;exchange_step:nth=3;seed=7"``.  Empty/None
+#: (default) = injection fully disabled — every site is one
+#: module-global None-check.  See docs/robustness.md for the grammar
+#: and site catalog.
+faults = os.environ.get("DAMPR_TPU_FAULTS") or None
 
 #: When set, every run is wrapped in a jax.profiler trace written under this
 #: directory (view with TensorBoard / xprof).  Structured per-stage metrics

@@ -302,7 +302,19 @@ class BlockRef(object):
             os.makedirs(directory, exist_ok=True)
             path = os.path.join(directory, uuid.uuid4().hex + ".blk")
             t0 = time.perf_counter()
-            save_block(self._block, path)
+
+            def write_once():
+                # Same transient-retry + fault-site contract as the
+                # background writer pool ("wb" truncates, so a retried
+                # partial write is idempotent).
+                from . import faults as _faults
+
+                _faults.check("spill_write")
+                save_block(self._block, path)
+
+            from . import faults as _faults
+
+            _faults.retry_io(write_once, "spill_write")
             secs = time.perf_counter() - t0
             self.path = path
             # The synchronous path feeds the same io bandwidth counters

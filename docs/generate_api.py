@@ -29,6 +29,7 @@ MODULES = [
     "dampr_tpu.plan.explain",
     "dampr_tpu.plan.lower",
     "dampr_tpu.runner",
+    "dampr_tpu.faults",
     "dampr_tpu.storage",
     "dampr_tpu.io",
     "dampr_tpu.io.codecs",

@@ -1,0 +1,800 @@
+"""Fault-injection harness + end-to-end failure recovery
+(dampr_tpu.faults): plan grammar and seeded reproducibility, error
+classification, backoff bounds, the classified job retry loop,
+poison-record quarantine (exactness, budget, idempotence across
+retries), IO-layer transient retries, crash auto-resume
+(resume="auto"), SIGTERM crashdumps, exchange-timeout shuffle degrade,
+slow-stop thread-leak warnings, the disabled-path pin, and the doctor's
+--faults surface."""
+
+import json
+import logging
+import operator
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dampr_tpu import Dampr, faults, settings
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with injection off and fault knobs at
+    defaults (the suite runs under one process)."""
+    saved = (settings.faults, settings.job_retries, settings.io_retries,
+             settings.max_quarantined, settings.retry_backoff_ms,
+             settings.retry_backoff_max_ms, settings.run_retries)
+    yield
+    (settings.faults, settings.job_retries, settings.io_retries,
+     settings.max_quarantined, settings.retry_backoff_ms,
+     settings.retry_backoff_max_ms, settings.run_retries) = saved
+    faults.clear()
+
+
+class TestPlanGrammar:
+    def test_parse_and_describe(self):
+        p = faults.FaultPlan(
+            "spill_write:p=0.25;udf:match=BAD,kind=deterministic;"
+            "exchange_step:nth=3;rank_kill:rank=1,exit=137;seed=42")
+        assert p.seed == 42
+        assert p.rules["spill_write"].p == 0.25
+        assert p.rules["udf"].match == "BAD"
+        assert p.rules["udf"].kind == "deterministic"
+        assert p.rules["exchange_step"].nth == 3
+        assert p.rules["exchange_step"].times == 1  # nth defaults once
+        assert p.rules["rank_kill"].exit_code == 137
+        d = p.describe()
+        assert d["seed"] == 42 and len(d["sites"]) == 4
+
+    def test_seed_position_independent(self):
+        a = faults.FaultPlan("seed=9;spill_write:p=0.5")
+        b = faults.FaultPlan("spill_write:p=0.5;seed=9")
+        seq_a = [a.rules["spill_write"].should_fire() for _ in range(64)]
+        seq_b = [b.rules["spill_write"].should_fire() for _ in range(64)]
+        assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+
+    def test_p_schedule_reproducible_and_seed_sensitive(self):
+        def seq(seed):
+            p = faults.FaultPlan("spill_write:p=0.3;seed={}".format(seed))
+            return [p.rules["spill_write"].should_fire()
+                    for _ in range(128)]
+
+        assert seq(1) == seq(1)
+        assert seq(1) != seq(2)
+
+    def test_bad_specs_raise(self):
+        for bad in ("spill_write", "udf:p=x", "udf:banana",
+                    "udf:kind=weird"):
+            with pytest.raises(faults.FaultSpecError):
+                faults.FaultPlan(bad)
+
+    def test_unknown_site_tolerated(self):
+        p = faults.FaultPlan("not_a_site:nth=1")
+        assert "not_a_site" in p.rules  # kept, warned, harmless
+
+    def test_match_rule_fires_every_probe(self):
+        """Content-keyed rules must fire deterministically on every
+        re-execution — the bisect relies on it."""
+        p = faults.FaultPlan("udf:match=POISON,kind=deterministic")
+        r = p.rules["udf"]
+        for _ in range(5):
+            assert r.should_fire(("POISON-x", 1))
+        assert not r.should_fire(("clean", 1))
+
+    def test_nth_and_times(self):
+        p = faults.FaultPlan("fold:nth=2,times=1")
+        r = p.rules["fold"]
+        assert [r.should_fire() for _ in range(5)] == [
+            False, True, False, False, False]
+
+
+class TestClassification:
+    def test_buckets(self):
+        assert faults.classify(OSError("disk")) == "transient"
+        assert faults.classify(TimeoutError()) == "transient"
+        assert faults.classify(ConnectionError()) == "transient"
+        assert faults.classify(
+            faults.TransientInjectedFault("x")) == "transient"
+        assert faults.classify(ValueError("bad record")) == "deterministic"
+        assert faults.classify(RuntimeError()) == "deterministic"
+        assert faults.classify(
+            faults.DeterministicInjectedFault("x")) == "deterministic"
+        assert faults.classify(MemoryError()) == "fatal"
+        assert faults.classify(KeyboardInterrupt()) == "fatal"
+        assert faults.classify(SystemExit(1)) == "fatal"
+        assert faults.classify(
+            faults.QuarantineOverflow("full")) == "fatal"
+        assert faults.classify(faults.FatalInjectedFault("x")) == "fatal"
+
+    def test_transient_fault_is_oserror(self):
+        # code catching real IO errors treats injected ones identically
+        assert isinstance(faults.TransientInjectedFault("x"), OSError)
+
+    def test_backoff_bounds(self):
+        settings.retry_backoff_ms = 40
+        settings.retry_backoff_max_ms = 300
+        for attempt in range(10):
+            for _ in range(20):
+                d = faults.backoff(attempt)
+                assert 0.0 <= d <= 0.3 + 1e-9
+        # early attempts bounded by base * 2^n
+        assert all(faults.backoff(0) <= 0.04 for _ in range(50))
+
+
+class TestDisabledPath:
+    def test_no_plan_no_cost_no_section_noise(self):
+        assert faults.active() is None
+        faults.check("spill_write")  # inert
+        faults.check_records("udf", [1], [2])
+        em = Dampr.memory(list(range(500))).map(lambda x: (x, 1)).run()
+        fa = em.stats()["faults"]
+        assert fa["enabled"] is False
+        assert fa["retries"] == 0 and fa["quarantined"] == 0
+        assert "plan" not in fa and "injected" not in fa
+        em.delete()
+
+    def test_stage_stats_carry_quarantined_field(self):
+        em = Dampr.memory(list(range(100))).map(lambda x: (x, 1)).run()
+        assert all(s["quarantined"] == 0 for s in em.stats)
+        em.delete()
+
+
+class TestClassifiedRetries:
+    def test_transient_retry_backs_off(self):
+        settings.job_retries = 2
+        settings.retry_backoff_ms = 20
+        faults.install(faults.FaultPlan("udf:nth=1,kind=transient"))
+        em = Dampr.memory(list(range(2000))).map(
+            lambda x: (x, x)).run(name="retry-transient")
+        fa = em.stats()["faults"]
+        assert fa["job_retries"] >= 1
+        assert fa["backoff_seconds"] > 0.0
+        assert fa["injected"] == {"udf": 1}
+        assert sorted(v for v in em.read())[:3] == [(0, 0), (1, 1), (2, 2)]
+        em.delete()
+
+    def test_deterministic_retry_no_backoff(self):
+        """Legacy contract: stateful flaky UDFs (deterministic class)
+        still retry, immediately."""
+        settings.job_retries = 2
+        state = {"n": 0}
+
+        def flaky(x):
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("transient-in-behavior")
+            return (x, x)
+
+        em = Dampr.memory([1, 2, 3], partitions=1).map(flaky).run(
+            name="retry-det")
+        fa = em.stats()["faults"]
+        assert fa["job_retries"] >= 1
+        assert fa["backoff_seconds"] == 0.0
+        em.delete()
+
+    def test_fatal_never_retried(self):
+        settings.job_retries = 5
+        calls = {"n": 0}
+
+        def oom(x):
+            calls["n"] += 1
+            raise MemoryError("boom")
+
+        with pytest.raises(MemoryError):
+            Dampr.memory([1], partitions=1).map(oom).run(name="retry-oom")
+        assert calls["n"] == 1  # one attempt, zero retries
+
+
+class TestQuarantine:
+    def _pipe(self, data):
+        return Dampr.memory(data).map(lambda s: (int(s), s))
+
+    def test_poison_record_quarantined_exactly(self, tmp_path):
+        """The chaos-exactness contract in miniature: results under
+        quarantine are byte-identical to a run whose input lacked the
+        poison records."""
+        settings.max_quarantined = 2
+        clean = [str(i) for i in range(5000)]
+        poisoned = clean[:1234] + ["POISON-A"] + clean[1234:] + ["POISON-B"]
+        got = self._pipe(poisoned).run(name="q-exact").read()
+        want = self._pipe(clean).run(name="q-clean").read()
+        assert got == want
+
+    def test_counts_and_sink_file(self):
+        settings.max_quarantined = 1
+        em = self._pipe(["1", "2", "oops", "3"]).run(name="q-counts")
+        s = em.stats()
+        fa = s["faults"]
+        assert fa["quarantined"] == 1
+        assert sum(st["quarantined"] for st in s["stages"]) == 1
+        recs = faults.load_quarantine("q-counts")
+        assert len(recs) == 1
+        assert "oops" in recs[0]["value"]
+        assert recs[0]["error"] == "ValueError"
+        em.delete()
+
+    def test_budget_overflow_fails_fast(self):
+        settings.max_quarantined = 1
+        settings.job_retries = 3
+        with pytest.raises(Exception) as ei:
+            self._pipe(["bad1", "bad2", "1"]).run(name="q-overflow")
+        # overflow is fatal: the original failure (or the overflow
+        # itself) surfaces without burning the retry budget
+        assert isinstance(ei.value, (faults.QuarantineOverflow,
+                                     ValueError))
+
+    def test_disabled_fails_fast_as_before(self):
+        assert settings.max_quarantined == 0
+        with pytest.raises(ValueError):
+            self._pipe(["1", "nope"]).run(name="q-off")
+
+    def test_duplicate_poison_records_each_count(self):
+        """Genuine duplicates are distinct record instances: each
+        counts against the budget and each gets a sink line — the
+        budget bounds real data loss, not distinct reprs."""
+        settings.max_quarantined = 2
+        data = ["1", "dup-bad", "2", "dup-bad", "3"]
+        em = self._pipe(data).run(name="q-dup")
+        fa = em.stats()["faults"]
+        assert fa["quarantined"] == 2
+        recs = faults.load_quarantine("q-dup")
+        assert len(recs) == 2
+        assert all("dup-bad" in r["value"] for r in recs)
+        assert sorted(em.read()) == [(1, "1"), (2, "2"), (3, "3")]
+        em.delete()
+
+    def test_duplicate_poison_overflows_single_budget(self):
+        settings.max_quarantined = 1
+        with pytest.raises(Exception) as ei:
+            self._pipe(["bad", "1", "bad"]).run(name="q-dup-over")
+        assert isinstance(ei.value, (faults.QuarantineOverflow,
+                                     ValueError))
+
+    def test_idempotent_across_job_retries(self):
+        """A transient fault in the same job as a poison record: the
+        retried job re-quarantines the same record without burning the
+        budget twice."""
+        settings.max_quarantined = 1
+        settings.job_retries = 3
+        faults.install(faults.FaultPlan("fold:nth=1,kind=transient"))
+        data = [str(i) for i in range(3000)] + ["POISON"]
+        em = (Dampr.memory(data, partitions=4)
+              .map(lambda s: (int(s) % 7, 1))
+              .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1])
+              .run(name="q-idem"))
+        fa = em.stats()["faults"]
+        assert fa["quarantined"] == 1
+        assert fa["job_retries"] >= 1
+        want = dict(Dampr.memory([str(i) for i in range(3000)],
+                                 partitions=4)
+                    .map(lambda s: (int(s) % 7, 1))
+                    .fold_by(lambda kv: kv[0], operator.add,
+                             lambda kv: kv[1])
+                    .run(name="q-idem-clean").read())
+        got = dict(em.read())
+        assert got == want
+        em.delete()
+
+
+class TestIoRetries:
+    def test_spill_write_transient_absorbed(self, tmp_path):
+        from dampr_tpu.ops.text import ParseNumbers
+        from dampr_tpu.runner import MTRunner
+
+        path = tmp_path / "nums.txt"
+        with open(path, "w") as f:
+            for i in range(60000):
+                f.write("{}\n".format((i * 2654435761) % (1 << 40)))
+        faults.install(faults.FaultPlan(
+            "spill_write:nth=1,kind=transient,times=2"))
+        settings.retry_backoff_ms = 5
+        old_dev = settings.use_device
+        settings.use_device = False
+        try:
+            pipe = (Dampr.text(str(path), chunk_size=64 * 1024)
+                    .custom_mapper(ParseNumbers())
+                    .checkpoint(force=True))
+            runner = MTRunner("io-retry", pipe.pmer.graph,
+                              memory_budget=1 << 18)
+            out = runner.run([pipe.source])
+            assert sum(len(b) for b in out[0].sorted_blocks()) == 60000
+        finally:
+            settings.use_device = old_dev
+        fa = runner.run_summary["faults"]
+        assert fa["io_retries"].get("spill_write", 0) >= 1
+        assert fa["retries"] >= 1
+        out[0].delete()
+        runner.store.cleanup()
+
+    def test_spill_read_transient_absorbed(self, tmp_path):
+        from dampr_tpu.io import frames
+        from dampr_tpu.io.codecs import resolve
+        from dampr_tpu.blocks import Block
+        import numpy as np
+
+        path = str(tmp_path / "f.blk")
+        arr = np.arange(5000, dtype=np.int64)
+        with open(path, "wb") as f:
+            frames.write_block_frames(Block(arr, arr.copy()), f,
+                                      resolve("zlib", 1), 1000)
+        faults.install(faults.FaultPlan(
+            "spill_read:nth=1,kind=transient,times=2"))
+        settings.retry_backoff_ms = 5
+        snap = faults.counters_snapshot()
+        r = frames.FrameReader(path)
+        payloads = list(r.iter_payloads())
+        assert len(payloads) == 5
+        _inj, io_r, io_backoff = faults.counters_delta(snap)
+        assert io_r.get("spill_read", 0) >= 1
+        assert io_backoff >= 0.0
+
+    def test_io_retry_budget_exhausted_raises(self):
+        faults.install(faults.FaultPlan("spill_write:p=1.0"))
+        settings.io_retries = 1
+        settings.retry_backoff_ms = 1
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            faults.check("spill_write")
+
+        with pytest.raises(faults.TransientInjectedFault):
+            faults.retry_io(always, "spill_write")
+        assert calls["n"] == 2  # initial + one retry
+
+    def test_deterministic_io_error_not_retried(self):
+        calls = {"n": 0}
+
+        def corrupt():
+            calls["n"] += 1
+            raise ValueError("corrupt frame")
+
+        with pytest.raises(ValueError):
+            faults.retry_io(corrupt, "spill_read")
+        assert calls["n"] == 1
+
+
+class TestAutoResume:
+    def _pipe(self):
+        return (Dampr.memory(list(range(4000)))
+                .map(lambda x: (x % 13, 1))
+                .checkpoint(force=True)
+                .fold_by(lambda kv: kv[0], operator.add,
+                         lambda kv: kv[1]))
+
+    def test_resume_auto_completes_byte_identical(self):
+        faults.install(faults.FaultPlan("fold:nth=1,kind=deterministic"))
+        em = self._pipe().run(name="auto-res", resume="auto")
+        got = sorted(em.read())
+        em.delete()
+        faults.clear()
+        cold = sorted(self._pipe().run(name="auto-cold").read())
+        assert got == cold
+
+    def test_resume_auto_requires_name(self):
+        with pytest.raises(ValueError, match="resume"):
+            Dampr.memory([1]).map(lambda x: (x, x)).run(resume="auto")
+
+    def test_fatal_never_auto_resumes(self):
+        calls = {"n": 0}
+
+        def oom(x):
+            calls["n"] += 1
+            raise MemoryError("fatal")
+
+        with pytest.raises(MemoryError):
+            Dampr.memory([1], partitions=1).map(oom).run(
+                name="auto-fatal", resume="auto")
+        assert calls["n"] == 1
+
+    def test_quarantine_survives_auto_resume(self):
+        """A failed attempt after a checkpointed quarantine: the retry
+        restores the stage from its manifest (no re-execution), and the
+        quarantine count + audit trail must survive the fresh runner."""
+        settings.max_quarantined = 1
+        state = {"fails": 1}
+
+        def flaky_reduce(k, vs):
+            if state["fails"] > 0:
+                state["fails"] -= 1
+                raise RuntimeError("dies once after the checkpoint")
+            return sum(v[1] for v in vs)
+
+        em = (Dampr.memory([str(i) for i in range(2000)] + ["POISONX"])
+              .map(lambda s: (int(s) % 5, 1))
+              .checkpoint(force=True)
+              .group_by(lambda kv: kv[0])
+              .reduce(flaky_reduce)
+              .run(name="auto-quar", resume="auto"))
+        fa = em.stats()["faults"]
+        assert fa["quarantined"] == 1, fa
+        recs = faults.load_quarantine("auto-quar")
+        assert len(recs) == 1 and "POISONX" in recs[0]["value"]
+        assert sum(v for _k, v in em.read()) == 2000
+        em.delete()
+
+    def test_settings_cleared_plan_cleared(self):
+        """The documented contract: settings.faults=None disables a
+        previously settings-installed plan on the next run."""
+        settings.faults = "udf:nth=1,kind=transient"
+        settings.job_retries = 1
+        em = Dampr.memory([1, 2, 3]).map(lambda x: (x, x)).run(
+            name="plan-on")
+        assert em.stats()["faults"]["enabled"] is True
+        em.delete()
+        settings.faults = None
+        em = Dampr.memory([1, 2, 3]).map(lambda x: (x, x)).run(
+            name="plan-off")
+        fa = em.stats()["faults"]
+        assert fa["enabled"] is False and fa["retries"] == 0
+        assert faults.active() is None
+        em.delete()
+
+    def test_retry_budget_exhausted_reraises(self):
+        settings.run_retries = 1
+
+        def always(x):
+            raise RuntimeError("persistent")
+
+        with pytest.raises(RuntimeError, match="persistent"):
+            Dampr.memory([1], partitions=1).map(always).run(
+                name="auto-exhaust", resume="auto")
+
+
+class TestExchangeTimeoutPlumbing:
+    def test_event_sidecar_roundtrip(self):
+        faults.clear_events("ev-run")
+        faults.record_event("ev-run", "exchange_timeout", stage=3,
+                            step=1, timeout_ms=500)
+        faults.record_event("ev-run", "exchange_timeout", stage=None)
+        evs = faults.load_events("ev-run")
+        assert len(evs) == 2
+        assert faults.stages_with_exchange_timeouts("ev-run") == {3}
+        faults.clear_events("ev-run")
+        assert faults.load_events("ev-run") == []
+
+    def test_events_bounded(self):
+        faults.clear_events("ev-cap")
+        for i in range(faults.EVENTS_CAP + 50):
+            faults.record_event("ev-cap", "exchange_timeout", stage=i)
+        evs = faults.load_events("ev-cap")
+        assert len(evs) == faults.EVENTS_CAP
+        assert evs[-1]["stage"] == faults.EVENTS_CAP + 49
+        faults.clear_events("ev-cap")
+
+    def test_shuffle_degrades_after_recorded_timeout(self):
+        """A recorded exchange timeout pins that stage's shuffle to the
+        host path on the next run, with a fault-history reason in the
+        plan report."""
+        from dampr_tpu.runner import MTRunner
+        from dampr_tpu import plan as _plan
+
+        old = settings.mesh_exchange
+        settings.mesh_exchange = "auto"
+        name = "degrade-run"
+
+        def build():
+            pipe = (Dampr.memory([(i % 5, i) for i in range(3000)],
+                                 partitions=4)
+                    .group_by(lambda x: x[0])
+                    .reduce(lambda k, vs: len(list(vs))))
+            return pipe
+
+        try:
+            pipe = build()
+            runner = MTRunner(name, pipe.pmer.graph)
+            _plan.apply_to_runner(runner, [pipe.source])
+            targets = (runner.plan_report.get("shuffle") or {}).get(
+                "targets") or []
+            mesh_sids = [d["sid"] for d in targets
+                         if d["target"] == "mesh"]
+            if not mesh_sids:
+                pytest.skip("no mesh-routed stage on this rig")
+            faults.clear_events(name)
+            faults.record_event(name, "exchange_timeout",
+                                stage=mesh_sids[0])
+            pipe2 = build()
+            runner2 = MTRunner(name, pipe2.pmer.graph)
+            _plan.apply_to_runner(runner2, [pipe2.source])
+            dec = {d["sid"]: d for d in
+                   runner2.plan_report["shuffle"]["targets"]}
+            assert dec[mesh_sids[0]]["target"] == "host"
+            assert "fault-history" in dec[mesh_sids[0]]["reason"]
+            assert runner2._shuffle_targets.get(mesh_sids[0]) == "host"
+        finally:
+            settings.mesh_exchange = old
+            faults.clear_events(name)
+
+    def test_forced_mesh_wins_over_fault_history(self):
+        from dampr_tpu.runner import MTRunner
+        from dampr_tpu import plan as _plan
+
+        old = settings.mesh_exchange
+        settings.mesh_exchange = "on"
+        name = "degrade-forced"
+        try:
+            pipe = (Dampr.memory([(i % 5, i) for i in range(3000)],
+                                 partitions=4)
+                    .group_by(lambda x: x[0])
+                    .reduce(lambda k, vs: len(list(vs))))
+            runner = MTRunner(name, pipe.pmer.graph)
+            _plan.apply_to_runner(runner, [pipe.source])
+            targets = (runner.plan_report.get("shuffle") or {}).get(
+                "targets") or []
+            mesh_sids = [d["sid"] for d in targets
+                         if d["target"] == "mesh"]
+            assert mesh_sids, targets
+            faults.clear_events(name)
+            faults.record_event(name, "exchange_timeout",
+                                stage=mesh_sids[0])
+            runner2 = MTRunner(name, pipe.pmer.graph)
+            _plan.apply_to_runner(runner2, [pipe.source])
+            dec = {d["sid"]: d for d in
+                   runner2.plan_report["shuffle"]["targets"]}
+            assert dec[mesh_sids[0]]["target"] == "mesh"
+        finally:
+            settings.mesh_exchange = old
+            faults.clear_events(name)
+
+
+class TestThreadLeakWarnings:
+    def test_sampler_slow_stop_warns(self, caplog):
+        from dampr_tpu.obs.metrics import Metrics
+        from dampr_tpu.obs.sampler import Sampler
+
+        faults.install(faults.FaultPlan(
+            "sampler_tick:nth=1,sleep_ms=3500"))
+        m = Metrics("slow-stop")
+        s = Sampler(m, interval_ms=10)
+        with caplog.at_level(logging.WARNING,
+                             logger="dampr_tpu.obs.sampler"):
+            s.start()
+            time.sleep(0.05)  # let the tick enter the injected stall
+            s.stop()
+        assert any("did not stop" in r.message
+                   and "dampr-tpu-sampler" in r.message
+                   for r in caplog.records), caplog.records
+
+    def test_overlap_producer_slow_stop_warns_and_drains(self, caplog):
+        """Kill-consumer pin: a consumer that dies mid-stream while the
+        producer is wedged must still drain every budget reservation
+        and name the stuck thread."""
+        import numpy as np
+
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.runner import _overlap_stream
+        from dampr_tpu.storage import RunStore
+
+        faults.install(faults.FaultPlan(
+            "overlap_produce:nth=3,sleep_ms=6000"))
+        store = RunStore("overlap-kill", budget=1 << 22)
+        old = settings.overlap_windows
+        settings.overlap_windows = 2
+
+        def codec():
+            for i in range(50):
+                arr = np.arange(1000, dtype=np.int64)
+                yield Block(arr, arr.copy())
+
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="dampr_tpu.runner"):
+                with pytest.raises(RuntimeError):
+                    for i, blk in enumerate(_overlap_stream(codec(),
+                                                            store)):
+                        if i == 1:
+                            raise RuntimeError("consumer died")
+            assert any("did not stop" in r.message
+                       for r in caplog.records), caplog.records
+            # reservations reconciled despite the wedged producer: the
+            # producer releases its own charge when it observes stop
+            deadline = time.time() + 10
+            while store.overlap_bytes != 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert store.overlap_bytes == 0
+        finally:
+            settings.overlap_windows = old
+            store.cleanup()
+
+
+class TestSigterm:
+    def test_sigterm_leaves_schema_valid_crashdump(self, tmp_path):
+        """A SIGTERM'd run must exit nonzero and leave a schema-valid
+        crashdump (previously only KeyboardInterrupt and injected
+        exceptions were pinned)."""
+        script = tmp_path / "victim.py"
+        ready = tmp_path / "ready"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            sys.path.insert(0, {root!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            from dampr_tpu import Dampr, settings
+            settings.trace = True
+            settings.trace_dir = {tdir!r}
+            settings.use_device = False
+            settings.max_processes = 1  # serial jobs: the signal lands
+            #                             in the main thread's UDF loop
+
+            def slow(x):
+                if x == 0:
+                    open({ready!r}, "w").write("up")
+                time.sleep(0.15)
+                return (x, x)
+
+            Dampr.memory(list(range(600)), partitions=2).map(
+                slow).run(name="sigterm-victim")
+            print("COMPLETED-UNEXPECTEDLY")
+        """).format(root=ROOT, tdir=str(tmp_path), ready=str(ready)))
+        proc = subprocess.Popen([sys.executable, str(script)],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        deadline = time.time() + 60
+        while not ready.exists() and time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        assert ready.exists(), proc.communicate()
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode != 0, (proc.returncode, out, err)
+        assert "COMPLETED-UNEXPECTEDLY" not in out
+        dump = os.path.join(str(tmp_path), "sigterm-victim", "trace",
+                            "crashdump.json")
+        assert os.path.isfile(dump), (out, err[-2000:])
+        with open(dump) as f:
+            doc = json.load(f)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_trace",
+            os.path.join(ROOT, "tools", "validate_trace.py"))
+        vt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vt)
+        with open(os.path.join(ROOT, "docs", "trace_schema.json")) as f:
+            schema = json.load(f)
+        assert not vt.validate(doc, schema)
+        assert doc["otherData"]["crash"]["exception"] == "SystemExit"
+
+
+class TestDoctorFaults:
+    def _diagnosed_run(self, tmp_path):
+        settings.max_quarantined = 1
+        settings.job_retries = 2
+        old = (settings.trace, settings.trace_dir)
+        settings.trace = True
+        settings.trace_dir = str(tmp_path)
+        faults.install(faults.FaultPlan(
+            "udf:nth=1,kind=transient,times=1"))
+        try:
+            em = (Dampr.memory([str(i) for i in range(3000)] + ["BAD"])
+                  .map(lambda s: (int(s), 1))
+                  .run(name="doc-faults"))
+            stats_file = em.stats()["stats_file"]
+            em.delete()
+        finally:
+            (settings.trace, settings.trace_dir) = old
+            faults.clear()
+        return stats_file
+
+    def test_findings_and_schema(self, tmp_path):
+        from dampr_tpu.obs import doctor
+
+        stats_file = self._diagnosed_run(tmp_path)
+        report = doctor.diagnose(stats_file)
+        bottlenecks = {f["bottleneck"] for f in report["findings"]}
+        assert "fault-retry" in bottlenecks
+        assert "quarantine" in bottlenecks
+        fa = report["faults"]
+        assert fa["retries"] >= 1 and fa["quarantined"] == 1
+        # machine report validates against the checked-in schema
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_doctor",
+            os.path.join(ROOT, "tools", "validate_doctor.py"))
+        vd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vd)
+        with open(os.path.join(ROOT, "docs", "doctor_schema.json")) as f:
+            schema = json.load(f)
+        errors = vd.validate(report, schema)
+        assert not errors, errors
+        # human rendering with --faults shows the section
+        text = doctor.format_report(report, show_faults=True)
+        assert "faults:" in text and "quarantined 1" in text
+
+    def test_exchange_timeout_finding(self, tmp_path):
+        from dampr_tpu.obs import doctor
+
+        old = (settings.trace, settings.trace_dir)
+        settings.trace = True
+        settings.trace_dir = str(tmp_path)
+        try:
+            em = Dampr.memory(list(range(200))).map(
+                lambda x: (x, 1)).run(name="doc-timeout")
+            stats_file = em.stats()["stats_file"]
+            em.delete()
+        finally:
+            (settings.trace, settings.trace_dir) = old
+        faults.clear_events("doc-timeout")
+        faults.record_event("doc-timeout", "exchange_timeout", stage=2,
+                            step=0, timeout_ms=500)
+        try:
+            report = doctor.diagnose(stats_file)
+            tof = [f for f in report["findings"]
+                   if f["bottleneck"] == "exchange-timeout"]
+            assert tof and tof[0]["severity"] == "high"
+            assert report["faults"]["exchange_timeouts"] == 1
+        finally:
+            faults.clear_events("doc-timeout")
+
+    def test_playbook_knobs_exist(self):
+        from dampr_tpu.obs.doctor import _PLAYBOOK
+
+        for verdict in ("fault-retry", "quarantine", "exchange-timeout"):
+            assert verdict in _PLAYBOOK
+            for knob, _env, propose, why in _PLAYBOOK[verdict]:
+                assert hasattr(settings, knob), (verdict, knob)
+                propose(getattr(settings, knob))  # never raises on current
+
+
+class TestWatchdog:
+    def test_watchdog_aborts_with_crashdump_and_event(self, tmp_path):
+        """A wedged collective step: the watchdog flushes a crashdump,
+        records the fault event, and exits the process within the
+        deadline bound (subprocess — it dies by design)."""
+        script = tmp_path / "wedge.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            sys.path.insert(0, {root!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            from dampr_tpu import settings, faults
+            settings.trace = True
+            settings.trace_dir = {tdir!r}
+            settings.scratch_root = {scratch!r}
+            from dampr_tpu.obs import flightrec
+            rec = flightrec.FlightRecorder("wedged-run", 64)
+            flightrec.start(rec)
+            faults.set_context(run="wedged-run", stage=4)
+            from dampr_tpu.parallel import exchange
+            done = exchange._step_watchdog(0, 400)
+            time.sleep(30)   # never sets done: the watchdog must kill us
+        """).format(root=ROOT, tdir=str(tmp_path),
+                    scratch=str(tmp_path / "scratch")))
+        t0 = time.time()
+        proc = subprocess.run([sys.executable, str(script)],
+                              capture_output=True, text=True,
+                              timeout=90)
+        elapsed = time.time() - t0
+        assert proc.returncode == 70, (proc.returncode, proc.stderr)
+        # bounded abort: deadline + flush, nowhere near the 30 s sleep
+        assert elapsed < 25, elapsed
+        dump = os.path.join(str(tmp_path), "wedged-run", "trace",
+                            "crashdump.json")
+        assert os.path.isfile(dump), proc.stderr[-2000:]
+        with open(dump) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["crash"]["reason"] == "exchange-timeout"
+        old_scratch = settings.scratch_root
+        settings.scratch_root = str(tmp_path / "scratch")
+        try:
+            assert faults.stages_with_exchange_timeouts(
+                "wedged-run") == {4}
+        finally:
+            settings.scratch_root = old_scratch
+
+
+class TestSiteCatalogDocs:
+    def test_documented_sites_match_module(self):
+        """docs/robustness.md's site table and faults.SITES stay in
+        sync."""
+        with open(os.path.join(ROOT, "docs", "robustness.md")) as f:
+            doc = f.read()
+        for site in faults.SITES:
+            assert "`{}`".format(site) in doc, site

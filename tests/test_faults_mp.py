@@ -1,0 +1,209 @@
+"""Rank-death chaos leg (docs/robustness.md): a REAL 2-process gloo
+deployment loses rank 1 to an injected kill mid-exchange, and the
+contract holds end to end —
+
+- rank 1 dies hard (``rank_kill`` fault, exit 137) but still leaves a
+  schema-valid crashdump (the kill action flushes the flight recorder);
+- rank 0's exchange watchdog (``settings.exchange_timeout_ms``) aborts
+  the hung gloo collective within the bounded deadline (measured from
+  rank 1's death: <= 2x the deadline), leaves its own crashdump, and
+  records the ``exchange_timeout`` fault event;
+- a follow-up single-process ``run(resume="auto")`` under the same name
+  restores the checkpointed prefix from rank 0's manifests, completes
+  byte-identical to a cold run, and its plan report shows the affected
+  stage's shuffle degraded to the host path with a fault-history
+  reason."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TIMEOUT_MS = 6000  # exchange watchdog deadline for the chaos leg
+
+#: The pipeline under test, exec'd VERBATIM by the workers and by the
+#: recovery/cold runs in this process — identical source means identical
+#: resume fingerprints (lambda bytecode included), so the recovery run
+#: genuinely restores the dead deployment's checkpoints.
+PIPELINE_SRC = textwrap.dedent("""
+    def build_pipe():
+        from dampr_tpu import Dampr
+        data = [(i % 13, (i * 2654435761) % 99991) for i in range(4000)]
+        return (Dampr.memory(data, partitions=8)
+                .map(lambda x: (x[0], x[1] * 2))
+                .checkpoint(force=True)
+                .group_by(lambda x: x[0])
+                .reduce(lambda k, vs: sorted(v[1] for v in vs)[:5]))
+""")
+
+_WORKER = textwrap.dedent("""
+    import os, sys, time
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, @ROOT@)
+    from dampr_tpu import settings, faults
+    settings.scratch_root = os.path.join(
+        os.environ["CHAOS_SCRATCH"], "rank%d" % pid)
+    settings.partitions = 8
+    settings.trace = True
+    settings.mesh_fold = "off"
+    settings.mesh_exchange = "on"
+    settings.exchange_timeout_ms = @TIMEOUT_MS@
+    from dampr_tpu.parallel.mesh import init_distributed
+    init_distributed(coordinator_address="localhost:%s" % port,
+                     num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+    # Rank 1 dies at its first collective exchange step — exactly where
+    # a real dead rank strands its peers.
+    settings.faults = "rank_kill:rank=1,nth=1,exit=137"
+
+    exec(@PIPELINE_SRC@)
+    from dampr_tpu.runner import MTRunner
+    pipe = build_pipe()
+    print("RUN_START_%d" % pid, flush=True)
+    runner = MTRunner("rankdeath", pipe.pmer.graph, resume=True)
+    runner.run([pipe.source])
+    print("UNEXPECTED_COMPLETE_%d" % pid, flush=True)
+""").replace("@ROOT@", repr(ROOT)).replace(
+    "@TIMEOUT_MS@", str(TIMEOUT_MS)).replace(
+    "@PIPELINE_SRC@", repr(PIPELINE_SRC))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _validate_crashdump(path):
+    import importlib.util
+
+    with open(path) as f:
+        doc = json.load(f)
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace", os.path.join(ROOT, "tools",
+                                       "validate_trace.py"))
+    vt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vt)
+    with open(os.path.join(ROOT, "docs", "trace_schema.json")) as f:
+        schema = json.load(f)
+    errors = vt.validate(doc, schema)
+    assert not errors, (path, errors)
+    return doc
+
+
+class TestRankDeath:
+    def test_kill_rank1_bounded_abort_and_auto_resume(self, tmp_path):
+        from dampr_tpu import faults, settings
+
+        port = _free_port()
+        scratch_base = str(tmp_path / "chaos")
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["CHAOS_SCRATCH"] = scratch_base
+        script = str(tmp_path / "worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(i), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            for i in range(2)]
+
+        # Rank 1 dies first (the injected kill).
+        out1, err1 = procs[1].communicate(timeout=240)
+        t_rank1_dead = time.time()
+        assert procs[1].returncode == 137, (
+            procs[1].returncode, out1, err1[-2000:])
+        assert "UNEXPECTED_COMPLETE_1" not in out1
+
+        # Rank-death bound: the survivor aborts within 2x the exchange
+        # deadline of rank 1's death — no hung gloo collective.
+        bound = 2 * TIMEOUT_MS / 1000.0
+        try:
+            out0, err0 = procs[0].communicate(timeout=bound + 30)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            raise AssertionError(
+                "rank 0 hung past the watchdog bound — the abort "
+                "path never fired")
+        t_rank0_dead = time.time()
+        assert procs[0].returncode == 70, (
+            procs[0].returncode, out0, err0[-2000:])
+        assert "UNEXPECTED_COMPLETE_0" not in out0
+        assert t_rank0_dead - t_rank1_dead <= bound, (
+            "abort took %.1fs, bound %.1fs"
+            % (t_rank0_dead - t_rank1_dead, bound))
+
+        # Schema-valid crashdumps on BOTH ranks, each naming its death.
+        dump0 = os.path.join(scratch_base, "rank0", "rankdeath",
+                             "trace", "crashdump.json")
+        dump1 = os.path.join(scratch_base, "rank1", "rankdeath",
+                             "trace", "rank1", "crashdump.rank1.json")
+        assert os.path.isfile(dump0), err0[-2000:]
+        assert os.path.isfile(dump1), err1[-2000:]
+        doc0 = _validate_crashdump(dump0)
+        doc1 = _validate_crashdump(dump1)
+        assert doc0["otherData"]["crash"]["reason"] == "exchange-timeout"
+        assert doc1["otherData"]["crash"]["reason"] == (
+            "fault-injected-kill")
+
+        # The watchdog recorded the timeout in rank 0's fault sidecar.
+        saved = (settings.scratch_root, settings.partitions,
+                 settings.mesh_fold)
+        settings.scratch_root = os.path.join(scratch_base, "rank0")
+        settings.partitions = 8
+        settings.mesh_fold = "off"
+        try:
+            evs = faults.load_events("rankdeath")
+            assert any(ev["kind"] == "exchange_timeout" for ev in evs), (
+                evs)
+
+            # Recovery: resume="auto" restores the checkpointed prefix
+            # from rank 0's manifests and completes on the host path
+            # (the fault-history degrade) — byte-identical to a cold
+            # single-process run.
+            g = {}
+            exec(PIPELINE_SRC, g)
+            em = g["build_pipe"]().run(name="rankdeath", resume="auto")
+            got = sorted(map(repr, em.read()))
+            kinds = [s["kind"] for s in em.stats]
+            assert any(k.startswith("resumed-") for k in kinds), kinds
+            shuffle = (em.stats().get("plan") or {}).get("shuffle") or {}
+            degraded = [d for d in shuffle.get("targets") or ()
+                        if "fault-history" in (d.get("reason") or "")]
+            assert degraded, shuffle
+            assert all(d["target"] == "host" for d in degraded)
+            em.delete()
+        finally:
+            (settings.scratch_root, settings.partitions,
+             settings.mesh_fold) = saved
+
+        # Cold single-process baseline in a fresh scratch root.
+        saved = (settings.scratch_root, settings.partitions,
+                 settings.mesh_fold)
+        settings.scratch_root = str(tmp_path / "cold")
+        settings.partitions = 8
+        settings.mesh_fold = "off"
+        try:
+            g = {}
+            exec(PIPELINE_SRC, g)
+            cold = sorted(map(repr,
+                              g["build_pipe"]().run(name="cold").read()))
+        finally:
+            (settings.scratch_root, settings.partitions,
+             settings.mesh_fold) = saved
+        assert got == cold, "auto-resume diverged from the cold run"
