@@ -91,6 +91,84 @@ def _bench_exchange(mesh, args):
     }
 
 
+def _bench_straggler(mesh, args):
+    """Injected-straggler scenario (docs/robustness.md "Straggler
+    mitigation"): rank ``--slow-rank`` sleeps ``--slow-ms`` at every
+    collective exchange step via the fault harness, and the SAME windows
+    run twice — a no-mitigation control and a mitigated pass
+    (``parallel.mitigate``) — under identical fresh fault plans.  Emits
+    ``mitigation_engaged``, ``speculative_wins``, ``stolen_partitions``,
+    ``windows_skipped`` / down-weighting, and the mitigated-vs-not wall.
+    Only meaningful under ``--mproc`` (the live skew signal crosses the
+    process boundary); single-process runs record the control wall and
+    an un-engaged mitigation."""
+    import jax
+
+    from dampr_tpu import faults, settings
+    from dampr_tpu.parallel import exchange as px
+    from dampr_tpu.parallel import mitigate
+    from dampr_tpu.parallel.mesh import mesh_size
+
+    n_dev = mesh_size(mesh)
+    blobs = _exchange_blobs(n_dev, min(args.exchange_mb, 1.0), seed=1)
+    payload = sum(len(b) for b in blobs.values())
+    px.mesh_blob_exchange(mesh, blobs)  # warm (compile) before faults
+    spec = ("exchange_step:rank={},sleep_ms={},every=1,times=100000"
+            .format(args.slow_rank, args.slow_ms))
+    windows = args.slow_windows
+
+    def drive():
+        t0 = time.time()
+        for _ in range(windows):
+            out = px.mesh_blob_exchange(mesh, blobs)
+            assert out == blobs, "exchange window not byte-identical"
+        return time.time() - t0
+
+    faults.configure(spec)
+    try:
+        control_wall = drive()
+    finally:
+        faults.clear()
+
+    # Degrade-in-place requires the bounded-collective regime: arm the
+    # exchange watchdog for the mitigated pass (generous deadline — it
+    # exists so a diverged skip could never hang, not to fire here).
+    saved_timeout = settings.exchange_timeout_ms
+    if settings.exchange_timeout_ms <= 0:
+        settings.exchange_timeout_ms = 120000
+    ctl = mitigate.MitigationController(run_name=None)
+    mitigate.start(ctl)
+    faults.configure(spec)  # fresh plan: identical injected schedule
+    try:
+        mitigated_wall = drive()
+    finally:
+        faults.clear()
+        mitigate.stop(ctl)
+        settings.exchange_timeout_ms = saved_timeout
+    s = ctl.summary()
+    if jax.process_count() <= 1:
+        sys.stderr.write(
+            "shuffle_bench: --slow-rank without --mproc measures the "
+            "control only (the live skew signal needs >= 2 ranks)\n")
+    return {
+        "slow_rank": args.slow_rank,
+        "slow_ms": args.slow_ms,
+        "slow_windows": windows,
+        "straggler_payload_bytes": payload,
+        "control_wall_s": round(control_wall, 3),
+        "mitigated_wall_s": round(mitigated_wall, 3),
+        "mitigation_speedup": (round(control_wall / mitigated_wall, 2)
+                               if mitigated_wall > 1e-9 else None),
+        "mitigation_engaged": s["engagements"] >= 1,
+        "mitigation_windows_skipped": s["windows_skipped"],
+        "speculative_wins": s["speculative_wins"],
+        "stolen_partitions": s["stolen_partitions"],
+        "downweighted_ranks": s["downweighted_ranks"],
+        "straggler_named": s["straggler_rank"],
+        "late_ratio": s["last_late_ratio"],
+    }
+
+
 def _obs_export(run_name, tracer, wall_start, wall, rec):
     """Per-rank artifact export for a traced bench run: trace.json +
     a minimal stats.json (schema dampr-tpu-stats/1) under the rank's
@@ -215,6 +293,8 @@ def _run_single(args):
     }
     rec.update(_bench_exchange(mesh, args))
     rec["value"] = rec["exchange_MBps"]
+    if args.slow_rank >= 0:
+        rec.update(_bench_straggler(mesh, args))
 
     if jax.process_count() == 1:
         x = rng.randn(n_dev * 1024, 256).astype(np.float32)
@@ -247,6 +327,10 @@ def _spawn_mproc(args):
                 "--iters", str(args.iters),
                 "--exchange-mb", str(args.exchange_mb),
                 "--devices-per-proc", str(args.devices_per_proc)]
+        if args.slow_rank >= 0:
+            cmd += ["--slow-rank", str(args.slow_rank),
+                    "--slow-ms", str(args.slow_ms),
+                    "--slow-windows", str(args.slow_windows)]
         if args.budget_mb:
             cmd += ["--budget-mb", str(args.budget_mb)]
         if args.cpu:
@@ -285,6 +369,15 @@ def main():
     ap.add_argument("--budget-mb", type=float, default=0,
                     help="exchange HBM budget override (MB); 0 = "
                          "settings.exchange_hbm_budget")
+    ap.add_argument("--slow-rank", type=int, default=-1,
+                    help="inject a straggler: this process rank sleeps "
+                         "--slow-ms at every collective exchange step "
+                         "(fault harness), and the bench reports "
+                         "mitigated-vs-not wall (-1 = off)")
+    ap.add_argument("--slow-ms", type=int, default=200,
+                    help="straggler stall per exchange step (ms)")
+    ap.add_argument("--slow-windows", type=int, default=16,
+                    help="exchange windows per straggler pass")
     ap.add_argument("--mproc", type=int, default=0,
                     help="spawn N local processes joined via "
                          "jax.distributed (gloo on CPU) and bench the "

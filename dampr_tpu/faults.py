@@ -169,12 +169,12 @@ class SiteRule(object):
     off the per-record hot path, so the lock cost is irrelevant)."""
 
     __slots__ = ("site", "p", "nth", "every", "times", "kind", "match",
-                 "rank", "sleep_ms", "exit_code", "invocations",
-                 "injected", "_rng", "_lock")
+                 "rank", "sleep_ms", "exit_code", "duration_ms",
+                 "invocations", "injected", "_t0", "_rng", "_lock")
 
     def __init__(self, site, seed=0, p=None, nth=None, every=None,
                  times=None, kind="transient", match=None, rank=None,
-                 sleep_ms=None, exit_code=None):
+                 sleep_ms=None, exit_code=None, duration_ms=None):
         self.site = site
         self.p = p
         self.nth = nth
@@ -192,6 +192,13 @@ class SiteRule(object):
         self.rank = rank
         self.sleep_ms = sleep_ms
         self.exit_code = exit_code
+        # Windowed firing (the `slow` duty-cycle modeling a rank that is
+        # slow for a while then RECOVERS — the straggler-mitigation
+        # disengage test vehicle): the rule only fires within
+        # ``duration_ms`` of its first invocation; past the window the
+        # site goes quiet (invocations still count).
+        self.duration_ms = duration_ms
+        self._t0 = None
         self.invocations = 0
         self.injected = 0
         # Per-site seeded stream: the schedule replays exactly under the
@@ -220,6 +227,12 @@ class SiteRule(object):
             if self.rank is not None and self.rank != _process_rank():
                 return False
             self.invocations += 1
+            if self.duration_ms is not None:
+                now = time.monotonic()
+                if self._t0 is None:
+                    self._t0 = now
+                if (now - self._t0) * 1000.0 > self.duration_ms:
+                    return False  # slow window over: the site recovered
             if self.times is not None and self.injected >= self.times:
                 return False
             if self.match is not None:
@@ -239,7 +252,7 @@ class SiteRule(object):
     def describe(self):
         out = {"site": self.site, "kind": self.kind}
         for k in ("p", "nth", "every", "times", "match", "rank",
-                  "sleep_ms", "exit_code"):
+                  "sleep_ms", "exit_code", "duration_ms"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v
@@ -259,7 +272,8 @@ def _process_rank():
 def _parse_value(key, val):
     if key == "p":
         return float(val)
-    if key in ("nth", "every", "times", "rank", "sleep_ms", "exit"):
+    if key in ("nth", "every", "times", "rank", "sleep_ms", "exit",
+               "duration_ms"):
         return int(val)
     return val
 
@@ -271,9 +285,14 @@ class FaultPlan(object):
 
         spec  := entry (';' entry)*
         entry := 'seed=' INT | SITE ':' kv (',' kv)*
-        kv    := ('p'|'nth'|'every'|'times'|'rank'|'sleep_ms'|'exit') '=' NUM
+        kv    := ('p'|'nth'|'every'|'times'|'rank'|'sleep_ms'|'exit'
+                  |'duration_ms') '=' NUM
                | 'kind' '=' ('transient'|'deterministic'|'fatal')
                | 'match' '=' TEXT
+
+    ``duration_ms`` windows any rule to the first N ms after its first
+    invocation — with ``sleep_ms`` it models a rank that is slow for a
+    while then recovers (the straggler-mitigation disengage vehicle).
     """
 
     def __init__(self, spec, seed=None):

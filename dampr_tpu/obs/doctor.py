@@ -121,6 +121,33 @@ _PLAYBOOK = {
          "and check worker-thread width"),
     ],
     "skew": [
+        ("mitigate", "DAMPR_TPU_MITIGATE",
+         lambda cur: "on",
+         "act on the skew instead of diagnosing it: the mitigation "
+         "controller steals work from backlogged queues, speculatively "
+         "re-executes straggler jobs (first-result-wins, exactly-once "
+         "under attempt-scoped commits), degrades collective exchanges "
+         "in place while a rank is late, and down-weights a "
+         "persistently pathological rank's partition share for the "
+         "rest of the run"),
+        ("speculate_threshold", "DAMPR_TPU_SPECULATE_THRESHOLD",
+         lambda cur: None,
+         "how many times slower than its peers (vs the other ranks' "
+         "mean entry lateness + the 20 ms jitter floor; for host jobs, "
+         "vs the median job duration) a worker must run before "
+         "mitigation engages — lower it to act on milder skew, raise "
+         "it if mitigation flaps on jitter"),
+        ("speculate_after_steps", "DAMPR_TPU_SPECULATE_AFTER",
+         lambda cur: None,
+         "consecutive pathological windows before engaging (and "
+         "healthy probes before disengaging) — the debounce between "
+         "acting fast and acting on noise"),
+        ("exchange_coding", "DAMPR_TPU_EXCHANGE_CODING",
+         lambda cur: "camr",
+         "coded aggregation for sum-combinable keyed folds: pre-fold "
+         "each exchange window per destination partition so fewer "
+         "bytes serialize on the slow rank's steps (replicated "
+         "map-side fold work traded for shuffle bytes)"),
         ("spill_read_prefetch", "DAMPR_TPU_SPILL_PREFETCH",
          lambda cur: max(4, int(cur or 0) * 2),
          "the straggler rank arrives late at collective steps — deeper "
@@ -452,6 +479,9 @@ def diagnose(run):
         # Schema discipline: typed optional keys are omitted, not null.
         fleet_report = {k: v for k, v in fleet_report.items()
                         if v is not None}
+        mitigation = fleet.get("mitigation") or summary.get("mitigation")
+        if mitigation:
+            fleet_report["mitigation"] = mitigation
         sec = skew.get("skew_seconds") or 0.0
         # A skew finding is worth ranking when the fleet measurably
         # waited: spreads covering >=5% of wall, or any step where the
@@ -474,6 +504,26 @@ def diagnose(run):
                     "idle", "host-compute"):
                 evidence += ("; that rank's own bottleneck is {} — fix "
                              "it there first".format(straggler_verdict))
+            mit = fleet.get("mitigation") or summary.get("mitigation")
+            if mit and mit.get("engagements"):
+                evidence += (
+                    "; mitigation ACTED on it ({} engagement(s), {} "
+                    "collective window(s) degraded in place, {} "
+                    "speculative win(s), {} stolen partition(s){})"
+                    .format(
+                        mit.get("engagements"),
+                        mit.get("windows_skipped") or 0,
+                        mit.get("speculative_wins") or 0,
+                        mit.get("stolen_partitions") or 0,
+                        ", down-weighted rank(s) {}".format(
+                            sorted(mit["downweighted_ranks"],
+                                   key=lambda r: int(r)))
+                        if mit.get("downweighted_ranks") else ""))
+            elif mit:
+                evidence += ("; mitigation was armed but never engaged "
+                             "(late_ratio stayed under "
+                             "speculate_threshold for "
+                             "speculate_after_steps windows)")
             findings.append({
                 "stage": None,
                 "bottleneck": "skew",
@@ -597,6 +647,8 @@ def diagnose(run):
         report["fleet"] = fleet_report
     if fault_section is not None:
         report["faults"] = fault_section
+    if summary.get("mitigation"):
+        report["mitigation"] = summary["mitigation"]
     return report
 
 
@@ -649,7 +701,19 @@ def diff(run_a, run_b):
         for k in sorted(set(set_a) | set(set_b))
         if set_a.get(k) != set_b.get(k)
     }
-    return {
+
+    def mit_counts(s):
+        m = s.get("mitigation") or {}
+        if not m:
+            return None
+        out = {k: m.get(k) or 0 for k in (
+            "engagements", "windows_skipped", "speculative_wins",
+            "stolen_partitions")}
+        out["downweighted_ranks"] = m.get("downweighted_ranks") or {}
+        return out
+
+    mit_a, mit_b = mit_counts(sum_a), mit_counts(sum_b)
+    report = {
         "schema": SCHEMA,
         "run": "{} vs {}".format(sum_a.get("run"), sum_b.get("run")),
         "wall_seconds": wall_b,
@@ -669,6 +733,11 @@ def diff(run_a, run_b):
             "settings_delta": settings_delta,
         },
     }
+    if mit_a or mit_b:
+        # Mitigation deltas: what each run DID about its skew — next to
+        # the knob deltas that changed the behavior.
+        report["diff"]["mitigation"] = {"a": mit_a, "b": mit_b}
+    return report
 
 
 def format_report(report, show_faults=False):
@@ -701,6 +770,19 @@ def format_report(report, show_faults=False):
                 add("  {}: {!r} -> {!r}".format(k, v["a"], v["b"]))
         else:
             add("settings: no recorded differences")
+        md = d.get("mitigation")
+        if md:
+            def _fmt_mit(m):
+                if not m:
+                    return "off"
+                return ("{} engagement(s), {} window(s) degraded, {} "
+                        "speculative win(s), {} stolen".format(
+                            m.get("engagements") or 0,
+                            m.get("windows_skipped") or 0,
+                            m.get("speculative_wins") or 0,
+                            m.get("stolen_partitions") or 0))
+            add("mitigation: {} -> {}".format(_fmt_mit(md.get("a")),
+                                              _fmt_mit(md.get("b"))))
         return "\n".join(lines)
 
     add("run {}: {:.2f}s wall · bottleneck: {}".format(
@@ -739,6 +821,21 @@ def format_report(report, show_faults=False):
                 if e.get("wall_seconds") is not None else "-",
                 "{:.1f}MB".format((e.get("spill_bytes") or 0) / 1e6),
                 e.get("verdict") or "?"))
+        mit = fl.get("mitigation")
+        if mit:
+            add("  mitigation: {} · {} engagement(s) · {} window(s) "
+                "degraded in place · {} speculative win(s) · {} stolen "
+                "partition(s){}".format(
+                    "ENGAGED" if mit.get("engaged") else "disengaged",
+                    mit.get("engagements") or 0,
+                    mit.get("windows_skipped") or 0,
+                    mit.get("speculative_wins") or 0,
+                    mit.get("stolen_partitions") or 0,
+                    " · down-weighted: {}".format({
+                        r: mit["downweighted_ranks"][r]
+                        for r in sorted(mit["downweighted_ranks"],
+                                        key=lambda r: int(r))})
+                    if mit.get("downweighted_ranks") else ""))
     if show_faults:
         fa = report.get("faults")
         if not fa:

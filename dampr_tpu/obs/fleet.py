@@ -227,6 +227,23 @@ def merge_traces(ranks, shifts, run_name=None):
 
 # -- skew --------------------------------------------------------------------
 
+def straggler_of(mean_late):
+    """``(straggler_rank, late_ratio)`` from per-rank mean entry
+    lateness ({rank: seconds late after the first arriver}).  The ratio
+    is the straggler's lateness over the fleet mean (>= 1.0) — the
+    number the doctor renders as "rank K enters steps N.Nx late" and the
+    live mitigation controller (:mod:`dampr_tpu.parallel.mitigate`)
+    thresholds against ``settings.speculate_threshold``.  One shared
+    definition so the post-hoc and live signals can never disagree."""
+    if not mean_late:
+        return None, 1.0
+    straggler = max(mean_late, key=mean_late.get)
+    fleet_mean = sum(mean_late.values()) / len(mean_late)
+    if fleet_mean <= 1e-12:
+        return straggler, 1.0
+    return straggler, mean_late[straggler] / fleet_mean
+
+
 def step_skew(ranks, shifts):
     """Per-collective-step skew from the aligned ``exchange`` step
     spans: for each chunked all_to_all step seen by >= 2 ranks, the
@@ -284,8 +301,7 @@ def step_skew(ranks, shifts):
     if not steps:
         return None
     mean_late = {rank: sum(ls) / len(ls) for rank, ls in lateness.items()}
-    straggler = max(mean_late, key=mean_late.get)
-    fleet_mean = sum(mean_late.values()) / len(mean_late)
+    straggler, late_ratio = straggler_of(mean_late)
     fracs = [s["fraction"] for s in steps]
     return {
         "steps": steps,
@@ -297,8 +313,7 @@ def step_skew(ranks, shifts):
                                 for r, v in sorted(mean_late.items())},
         # How much later the straggler enters collectives than the fleet
         # average (>= 1; the doctor's "rank K enters steps N.Nx late").
-        "late_ratio": (round(mean_late[straggler] / fleet_mean, 2)
-                       if fleet_mean > 1e-12 else 1.0),
+        "late_ratio": round(late_ratio, 2),
     }
 
 
@@ -434,6 +449,21 @@ def fleet_section(ranks, shifts=None, alignment=None):
     matrices = _exchange_matrices(ranks, num, n_dev)
     if matrices is not None:
         section["exchange"] = matrices
+    # Mitigation visibility (dampr_tpu.parallel.mitigate): the shared
+    # collective state (engagements, skipped windows, down-weights) is
+    # identical on every rank by construction, but steals and
+    # speculative wins are LOCAL per-rank counters — the fleet view
+    # sums them so host-path mitigation on any rank is visible, next to
+    # the skew that triggered it.
+    mits = [(rank, (data.get("stats") or {}).get("mitigation"))
+            for rank, data in sorted(ranks.items())
+            if (data.get("stats") or {}).get("mitigation")]
+    if mits:
+        merged = dict(mits[0][1])
+        for key in ("speculative_attempts", "speculative_wins",
+                    "stolen_partitions"):
+            merged[key] = sum(int(m.get(key) or 0) for _r, m in mits)
+        section["mitigation"] = merged
     skew = step_skew(ranks, shifts)
     if skew is not None:
         section["skew"] = skew

@@ -53,7 +53,9 @@ _KNOBS = ("partitions", "batch_size", "max_memory_per_stage",
           "merge_fanin", "max_processes", "optimize", "profile",
           "mesh_exchange", "exchange_hbm_budget", "exchange_chunk_bytes",
           "exchange_min_bytes", "job_retries", "io_retries",
-          "retry_backoff_ms", "max_quarantined", "exchange_timeout_ms")
+          "retry_backoff_ms", "max_quarantined", "exchange_timeout_ms",
+          "mitigate", "speculate_threshold", "speculate_after_steps",
+          "mitigate_probe_windows", "exchange_coding")
 
 
 def corpus_path(run_name):

@@ -44,11 +44,13 @@ import logging
 import os
 import pickle
 import threading
+import time
 
 import numpy as np
 
 from .. import faults as _faults
 from .. import settings
+from . import mitigate as _mitigate
 from . import replan
 from .mesh import mesh_size, shard_map as _shard_map
 
@@ -141,7 +143,7 @@ def _step_watchdog(step_i, timeout_ms):
     return done
 
 
-def mesh_blob_exchange(mesh, blobs, budget=None):
+def mesh_blob_exchange(mesh, blobs, budget=None, coding=None):
     """Move arbitrary byte blobs across the mesh, under an HBM budget.
 
     ``blobs``: {(src_device, dst_device): bytes}.  Returns the delivered
@@ -155,6 +157,18 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
     order, so the result is byte-identical to a single collective.  Each
     step emits ``exchange`` spans for its pack (h2d staging), collective,
     and unpack (d2h fetch) phases.
+
+    Straggler mitigation (``dampr_tpu.parallel.mitigate``): when an
+    engaged controller says to skip this window (degrade-in-place), the
+    blobs are returned verbatim — the exchange is a placement transport
+    whose delivered content equals its input byte-for-byte (the
+    multi-process gather replicates everything to every host), so the
+    skip is exact by construction and ``last_info["skipped"]`` records
+    it.  On multi-process runs each executed window also piggybacks a
+    tiny all_gather of per-rank step-entry times (on the
+    ``mesh.clock_sync`` barrier-aligned clock), feeding the controller
+    the LIVE form of the skew signal ``obs.fleet.step_skew`` computes
+    post-hoc.
     """
     import jax
 
@@ -162,10 +176,25 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
 
     global last_info
     D = mesh_size(mesh)
+    ctl = _mitigate.active()
+    if ctl is not None and not ctl.use_collective():
+        # Degrade-in-place: the fleet stops serializing on the straggler
+        # at every chunked step; content is identical by construction.
+        _trace.instant("mitigation", "window-skipped",
+                       bytes=sum(len(b) for b in blobs.values()))
+        last_info = {
+            "steps": 0, "bytes": 0, "peak_inflight_bytes": 0,
+            "budget": (budget if budget is not None
+                       else settings.exchange_hbm_budget),
+            "clamped": False, "skipped": True,
+            "sent_per_device": [0] * D, "received_per_device": [0] * D,
+            "pair_bytes": {},
+        }
+        return dict(blobs)
     gather = jax.process_count() > 1
     sched = replan.plan_exchange(
         D, {sd: len(b) for sd, b in blobs.items()},
-        budget=budget, gather=gather)
+        budget=budget, gather=gather, coding=coding)
     sent = [0] * D
     received = [0] * D
     pair = {}  # (src_device, dst_device) -> payload bytes this exchange
@@ -174,6 +203,7 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
         if n:
             pair[(s, d)] = pair.get((s, d), 0) + n
     parts = {}
+    entry_perf = None
     for i, step in enumerate(sched.steps):
         buf = np.zeros((D * D, step.capacity), dtype=np.uint8)
         lens = np.zeros(D * D, dtype=np.int32)
@@ -196,6 +226,12 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
         # ``exchange_step`` (classified failures on the step itself).
         _faults.check("rank_kill")
         _faults.check("exchange_step")
+        if i == 0:
+            # First-step collective entry on this rank's monotonic clock
+            # — AFTER the fault checks, so an injected slow stretch
+            # (sleep_ms) shows up as entry lateness exactly like real
+            # host-side straggling would.  Shared fleet-wide below.
+            entry_perf = time.perf_counter()
         timeout_ms = settings.exchange_timeout_ms
         guard = None
         if timeout_ms > 0:
@@ -223,6 +259,36 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
                         rb[row, :n].tobytes())
                     received[d] += n
     out = {sd: b"".join(ps) for sd, ps in parts.items()}
+    if ctl is not None and gather and entry_perf is not None:
+        # Live skew observation: one tiny all_gather of (entry time,
+        # transient-fault count) per rank — every rank receives the SAME
+        # vector, so controller state transitions stay identical
+        # fleet-wide (the invariant the skip/route decisions rely on).
+        # The share is a collective like any step, so it gets the same
+        # rank-death watchdog: a peer dying between its last payload
+        # step and this gather must produce the bounded abort, never a
+        # hung gloo collective.
+        # Divergence discipline: the except branch below is only safe
+        # because everything inside the try is either DETERMINISTIC
+        # (the jit build — a compile error fails every rank
+        # identically, so every controller misses the same
+        # observation) or a COLLECTIVE (whose runtime failures are the
+        # watchdog's jurisdiction, same as any payload step).  The
+        # pure host-side fold of the gathered vector happens inside
+        # _share_skew after the materialization and cannot fail
+        # one-sided short of a 64-byte MemoryError.
+        timeout_ms = settings.exchange_timeout_ms
+        guard = None
+        if timeout_ms > 0:
+            watchdogs_armed += 1
+            guard = _step_watchdog("skew-share", timeout_ms)
+        try:
+            _share_skew(mesh, D, ctl, entry_perf)
+        except Exception:
+            log.warning("mitigation skew share failed", exc_info=True)
+        finally:
+            if guard is not None:
+                guard.set()
     for d in range(D):
         if sent[d]:
             sent_bytes_per_device[d] = (
@@ -245,7 +311,81 @@ def mesh_blob_exchange(mesh, blobs, budget=None):
         # send/recv matrix the straggler diagnosis reads.
         "pair_bytes": pair,
     }
+    if sched.coding:
+        last_info["coding"] = dict(sched.coding)
     return out
+
+
+@functools.lru_cache(maxsize=None)
+def _build_share(mesh, axis):
+    """Tiny all_gather program for the mitigation piggyback: every
+    device contributes one (entry time, fault count) row; every host
+    reads the full per-device matrix."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def per_device(t):
+        return lax.all_gather(t, axis, tiled=True)
+
+    return jax.jit(_shard_map(per_device, mesh=mesh, in_specs=(P(axis),),
+                              out_specs=P(), check_vma=False))
+
+
+_warned_no_clock = False
+
+
+def _share_skew(mesh, D, ctl, entry_perf):
+    """Share this rank's first-step entry time (barrier-aligned clock)
+    and cumulative transient-retry count across the fleet, then feed the
+    controller's live observation (which differences the retry counts
+    per window).  ~D*12 bytes per window — noise next to the payload
+    schedule it rides behind."""
+    import jax
+
+    from ..obs.fleet import _rank_of_device
+    from .mesh import clock_sync
+
+    global _warned_no_clock
+    if clock_sync is None:
+        # No common clock anchor (the init_distributed barrier
+        # handshake failed, symmetrically — it is itself a collective):
+        # raw per-host monotonic clocks measure time since each host's
+        # BOOT, so cross-rank differences would be pure garbage that
+        # could engage on a perfectly healthy fleet.  No observation is
+        # strictly better than a wrong one.
+        if not _warned_no_clock:
+            _warned_no_clock = True
+            log.warning(
+                "mitigation: no clock handshake (mesh.clock_sync is "
+                "None) — live skew observation disabled for this "
+                "process; host-path stealing/speculation stay active")
+        return
+    nproc = jax.process_count()
+    base = clock_sync["barrier_perf"]
+    # Split integer/fraction lanes: jax truncates float64 inputs to
+    # float32 with x64 off, whose ~8 ms quantization past a day of
+    # barrier-relative time would dwarf the 20 ms jitter floor.  The
+    # integer-seconds lane is exact below 2^24 s and the fraction lane
+    # keeps sub-microsecond resolution at any run length.
+    t = entry_perf - base
+    vec = np.zeros((D, 3), dtype=np.float32)
+    vec[:, 0] = np.float32(int(t))
+    vec[:, 1] = np.float32(t - int(t))
+    vec[:, 2] = np.float32(ctl.local_fault_count())
+    out = np.asarray(_build_share(mesh, settings.mesh_axis)(vec))
+    entries, fault_counts = {}, {}
+    # One authoritative device->rank mapping (the same helper the fleet
+    # merge and the weighted route table use — three copies of the
+    # ownership assumption could silently disagree).
+    for d in range(D):
+        r = _rank_of_device(d, nproc, D)
+        if r not in entries:
+            entries[r] = float(out[d, 0]) + float(out[d, 1])
+            fault_counts[r] = int(out[d, 2])
+    first = min(entries.values())
+    ctl.observe_window({r: t - first for r, t in entries.items()},
+                       fault_counts=fault_counts)
 
 
 def _pack_group(items):
@@ -281,30 +421,46 @@ received_bytes_per_device = {}
 pair_bytes_per_route = {}
 
 
-def mesh_shuffle_blocks(mesh, routed):
+def mesh_shuffle_blocks(mesh, routed, coding=None):
     """Exchange one window of routed blocks across the mesh.
 
     ``routed``: iterable of (seq, src_shard, pid, Block) — seq is a caller
     sequence number used to restore deterministic per-partition block order
     on the receive side (the engine's group-value order is arrival order,
-    reference semantics).  Destination device is ``pid % D``.
+    reference semantics).  Destination device is ``pid % D`` — unless a
+    mitigation controller holds a sticky down-weight, in which case the
+    weighted routing table re-maps partitions away from the slow rank's
+    devices (content-neutral: placement only, every host reads the full
+    delivered set).
 
     Returns ``(received, bytes_moved)``: received is a list of (pid, Block)
     sorted by seq; bytes_moved counts payload bytes that crossed the
-    collective.
+    collective (0 for a mitigation-skipped window — nothing moved).
     """
     from ..obs import trace as _trace
 
     global total_exchanges, total_bytes, total_steps, peak_inflight_bytes
     D = mesh_size(mesh)
+    route = None
+    ctl = _mitigate.active()
+    if ctl is not None:
+        import jax
+
+        route = ctl.route_table(D, jax.process_count())
+
+    def dst(pid):
+        return route[pid % len(route)] if route else pid % D
+
     groups = {}
     for seq, src, pid, blk in routed:
-        groups.setdefault((src % D, pid % D), []).append((seq, pid, blk))
+        groups.setdefault((src % D, dst(pid)), []).append((seq, pid, blk))
     blobs = {sd: _pack_group(items) for sd, items in groups.items()}
     moved = sum(len(b) for b in blobs.values())
     with _trace.span("collective", "exchange", bytes=moved,
                      blobs=len(blobs)):
-        recv = mesh_blob_exchange(mesh, blobs)
+        recv = mesh_blob_exchange(mesh, blobs, coding=coding)
+    if last_info is not None and last_info.get("skipped"):
+        moved = 0  # degrade-in-place: nothing crossed the mesh
     total_exchanges += 1
     total_bytes += moved
     if last_info is not None:
@@ -314,7 +470,7 @@ def mesh_shuffle_blocks(mesh, routed):
     out = []
     for (s, d), blob in recv.items():
         for seq, pid, blk in _unpack_group(blob):
-            assert pid % D == d, (pid, d)
+            assert dst(pid) == d, (pid, d)
             out.append((seq, pid, blk))
     out.sort(key=lambda t: t[0])
     return [(pid, blk) for _seq, pid, blk in out], moved

@@ -99,14 +99,21 @@ class ExchangeSchedule(object):
     """The planned step sequence plus the invariants callers report:
     ``peak_inflight_bytes`` (max of the per-step model) and ``clamped``
     (budget below the capacity floor — the only case where
-    ``peak_inflight_bytes > budget``)."""
+    ``peak_inflight_bytes > budget``).  ``coding`` carries the CAMR-style
+    coded-aggregation record when the caller pre-folded sum-combinable
+    partials before planning (``settings.exchange_coding``): mode,
+    ``raw_bytes`` (what the uncoded schedule would have moved) and
+    ``coded_bytes`` (what this schedule moves) — replicated map-side
+    fold work traded for shuffle bytes, arXiv 1901.07418."""
 
-    def __init__(self, n_dev, steps, budget, gather, clamped):
+    def __init__(self, n_dev, steps, budget, gather, clamped,
+                 coding=None):
         self.n_dev = n_dev
         self.steps = steps
         self.budget = budget
         self.gather = gather
         self.clamped = clamped
+        self.coding = coding
         self.total_bytes = sum(s.payload_bytes() for s in steps)
         self.peak_inflight_bytes = max(
             (s.inflight_bytes for s in steps), default=0)
@@ -117,7 +124,7 @@ class ExchangeSchedule(object):
 
 
 def plan_exchange(n_dev, sizes, budget=None, gather=False,
-                  chunk_bytes=None):
+                  chunk_bytes=None, coding=None):
     """Plan a budget-bounded exchange of ``sizes`` ({(src, dst): nbytes})
     across an ``n_dev`` mesh.
 
@@ -126,6 +133,10 @@ def plan_exchange(n_dev, sizes, budget=None, gather=False,
     additionally caps the per-piece size below what the budget allows —
     the explicit chunk-size knob the doctor playbook points at when a
     device is memory-pressured beyond what the budget models.
+    ``coding`` (optional dict) records a coded-aggregation pre-fold on
+    the returned schedule — the *sizes already reflect* the coded
+    payload; the record keeps the raw-vs-coded byte evidence with the
+    schedule it shaped.
     """
     if budget is None:
         budget = settings.exchange_hbm_budget
@@ -154,4 +165,5 @@ def plan_exchange(n_dev, sizes, budget=None, gather=False,
         steps.append(ExchangeStep(
             cells, capacity,
             step_inflight_bytes(n_dev, capacity, gather)))
-    return ExchangeSchedule(n_dev, steps, budget, gather, clamped)
+    return ExchangeSchedule(n_dev, steps, budget, gather, clamped,
+                            coding=coding)

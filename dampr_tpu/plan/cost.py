@@ -139,9 +139,12 @@ def shuffle_choice(hist_stage, n_dev, n_partitions, mode=None):
     detail = "~{:.0f} B/record, ".format(rec_bytes) if rec_bytes else ""
     return "mesh", (
         "history: {} B shuffle input across {} partitions on {} devices "
-        "({}windowed under exchange_hbm_budget={})".format(
+        "({}windowed under exchange_hbm_budget={}){}".format(
             bytes_in, n_partitions, n_dev, detail,
-            settings.exchange_hbm_budget))
+            settings.exchange_hbm_budget,
+            "; coded aggregation armed (exchange_coding={}) for "
+            "sum-combinable folds".format(settings.exchange_coding)
+            if settings.exchange_coding_enabled() else ""))
 
 
 def _clamped_partitions(reduce_bytes):

@@ -305,6 +305,13 @@ def apply_shuffle(runner, report):
     section["targets"] = decisions
     section["mesh_stages"] = sum(
         1 for d in decisions if d["target"] == "mesh")
+    if settings.exchange_coding_enabled():
+        # Coded aggregation (parallel.replan / runner._code_exchange_batch):
+        # sum-combinable keyed folds routed over the byte exchange
+        # pre-fold each window per destination partition — the run
+        # summary's mesh.exchange.coding section carries the measured
+        # raw-vs-coded bytes this mode traded.
+        section["coding"] = str(settings.exchange_coding)
     routing = {d["sid"]: d["target"] for d in decisions
                if d["target"] in ("mesh", "host")}
     try:

@@ -36,6 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from . import base, faults as _faults, settings, storage
+from .parallel import mitigate as _mitigate
 from .blocks import Block, BlockBuilder
 from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
 from .graph import GInput, GMap, GReduce, GSink
@@ -836,12 +837,21 @@ class MTRunner(object):
         # module's cumulative per-device/per-route counters at run start,
         # differenced at finalize so stats() carries THIS run's matrix.
         self._exchange_snapshot = None
+        # Straggler mitigation (parallel.mitigate, settings.mitigate):
+        # work stealing + speculative re-execution on the host path,
+        # live-skew degrade-in-place + sticky down-weighting on the
+        # collective path.  Off = one None-check per site.
+        self._mitigation = None
+        # CAMR-style coded-exchange accounting (settings.exchange_coding):
+        # window pre-folds traded for shuffle bytes, summed per run.
+        self.coded_exchange = {"windows": 0, "raw_bytes": 0,
+                               "coded_bytes": 0}
         # Failed runs must not feed the run-history corpus (their
         # measurements would poison the adaptation medians).
         self._run_failed = False
 
     # -- job fan-out --------------------------------------------------------
-    def _pool_run(self, fn, jobs, n_workers, label=None):
+    def _pool_run(self, fn, jobs, n_workers, label=None, speculative=True):
         retries = settings.job_retries
         if retries:
             inner = fn
@@ -866,6 +876,14 @@ class MTRunner(object):
                             raise
                         delay = (_faults.backoff(attempt)
                                  if kind == "transient" else 0.0)
+                        ctl = _mitigate.active()
+                        if ctl is not None and kind == "transient":
+                            # Local transient-fault rate: shared with
+                            # the fleet on the next exchange window's
+                            # piggyback — a rank drowning in retries
+                            # earns the sticky down-weight even before
+                            # its step entries turn late.
+                            ctl.note_local_retry()
                         with self._retry_lock:
                             self.retries_total += 1
                             self._backoff_seconds += delay
@@ -886,6 +904,15 @@ class MTRunner(object):
                 #                          worker thread = one lane per slot
                 with _trace.span("job", label):
                     return _inner(job)
+
+        # Speculative duplicate attempts re-run the job but must not
+        # re-run the one-call-per-job accounting below: the profiler's
+        # job thread-seconds (the coverage denominator) and the
+        # jobs_started/done counters both assume one counted call per
+        # job — a losing duplicate would inflate them (10/8 jobs done).
+        # Retry + trace-span wrappers DO apply to duplicates (a real
+        # attempt deserves a real span).
+        fn_speculative = fn
 
         prof = _profile.active()
         if prof is not None:
@@ -922,6 +949,19 @@ class MTRunner(object):
         n_workers = max(1, min(n_workers, len(jobs), settings.max_processes))
         if n_workers == 1 or len(jobs) <= 1:
             return [fn(j) for j in jobs]
+        ctl = _mitigate.active()
+        if ctl is not None:
+            # Mitigation-aware dispatch: rank-owned per-worker queues
+            # with work stealing, plus speculative re-execution of
+            # straggler jobs (first-result-wins under attempt-scoped
+            # commits).  Sinks never speculate (duplicate part-file
+            # writes would race on one path); quarantine-armed runs
+            # don't either (a losing duplicate's quarantine commits
+            # would double-count poison records against the budget).
+            return _mitigate.pool_dispatch(
+                ctl, fn, jobs, n_workers, store=self.store,
+                speculative=(speculative and self._quarantine is None),
+                spec_fn=fn_speculative)
         with ThreadPoolExecutor(max_workers=n_workers) as pool:
             return list(pool.map(fn, jobs))
 
@@ -1650,6 +1690,14 @@ class MTRunner(object):
         mode = str(settings.mesh_fold).lower()
         if mode in ("off", "0", "false") or not settings.use_device:
             return None
+        ctl = _mitigate.active()
+        if ctl is not None and not ctl.collective_fold_ok():
+            # Degrade-in-place: while the mitigation is engaged the
+            # collective fold would re-serialize the fleet on the
+            # straggler at every window — the host path is exact and
+            # free-running.  Deterministic from shared controller state,
+            # so every rank declines together (no one-sided collective).
+            return None
         if len(entries) != 1 or not isinstance(stage.reducer,
                                                base.AssocFoldReducer):
             return None
@@ -1957,14 +2005,59 @@ class MTRunner(object):
                  nrec, len(jax.devices()))
         return pset, nrec, 1
 
-    def _mesh_exchange_entries(self, entries, target=None):
+    def _code_exchange_batch(self, batch, op):
+        """CAMR-style coded aggregation (settings.exchange_coding): fold
+        each destination partition's window blocks into ONE partial under
+        the stage's associative op BEFORE they cross the mesh — replicated
+        map-side fold work traded for strictly fewer shuffle bytes
+        (duplicate keys collapse host-side; arXiv 1901.07418).  Exactness
+        gate per partition: integer lanes for sums (float summation order
+        would drift ulps), any real numeric lane for min/max; ineligible
+        or fold-failing partitions ship raw.  Returns (coded batch,
+        raw_bytes, coded_bytes)."""
+        by_pid = {}
+        raw_bytes = 0
+        for s, pid, item in batch:
+            blk = (item.get() if isinstance(item, storage.BlockRef)
+                   else item)
+            raw_bytes += blk.nbytes()
+            by_pid.setdefault(pid, []).append((s, blk))
+        out = []
+        coded_bytes = 0
+        for pid in sorted(by_pid):
+            items = by_pid[pid]
+            blocks = [b for _s, b in items]
+            seq0 = min(s for s, _b in items)
+            merged = blocks[0] if len(blocks) == 1 else Block.concat(
+                blocks)
+            vals = merged.values
+            kinds = "iu" if op.kind == "sum" else "iuf"
+            eligible = vals.ndim == 1 and vals.dtype.kind in kinds
+            if eligible:
+                try:
+                    folded = segment.fold_block(merged, op)
+                except Exception:  # exactness fallback: ship raw
+                    eligible = False
+            if eligible:
+                coded_bytes += folded.nbytes()
+                out.append((seq0, pid, folded))
+            else:
+                for s, blk in items:
+                    coded_bytes += blk.nbytes()
+                    out.append((s, pid, blk))
+        return out, raw_bytes, coded_bytes
+
+    def _mesh_exchange_entries(self, entries, target=None, reducer=None):
         """The general shuffle on the mesh (the reference's universal
         DefaultShuffler — base.py:416-433 — as a collective): every input
         partition's blocks cross a budget-scheduled ``all_to_all`` byte
         exchange, streamed in windows bounded by the run budget, with
         partition pid landing on device pid % D.  Joins stay co-partitioned
         because both inputs route identically.  ``target`` is the plan
-        layer's shuffle choice for this stage (see ``_exchange_mesh_gate``).
+        layer's shuffle choice for this stage (see ``_exchange_mesh_gate``);
+        ``reducer`` (the consuming stage's reducer, when there is exactly
+        one input) arms the coded-aggregation pre-fold for sum-combinable
+        keyed folds under ``settings.exchange_coding``.
         Returns the exchanged PartitionSets (new refs registered against
         the store), or None when the mesh path is disabled or only one
         device is visible."""
@@ -1973,6 +2066,13 @@ class MTRunner(object):
             return None
         mesh, D, window = gate
         from .parallel import exchange as px
+
+        coding_op = None
+        if (settings.exchange_coding_enabled() and len(entries) == 1
+                and isinstance(reducer, base.AssocFoldReducer)
+                and getattr(reducer.op, "kind", None)
+                in ("sum", "min", "max")):
+            coding_op = reducer.op
 
         out_entries = []
         ran_exchange = False
@@ -1985,12 +2085,31 @@ class MTRunner(object):
                 nonlocal batch, batch_bytes, ran_exchange
                 if not batch:
                     return
+                coding_info = None
+                if coding_op is not None:
+                    coded, raw_b, coded_b = self._code_exchange_batch(
+                        batch, coding_op)
+                    batch = coded
+                    coding_info = {"mode": "camr", "raw_bytes": raw_b,
+                                   "coded_bytes": coded_b}
                 routed = [
                     (s, s % D, pid,
                      item.get() if isinstance(item, storage.BlockRef)
                      else item)
                     for s, pid, item in batch]
-                received, moved = px.mesh_shuffle_blocks(mesh, routed)
+                received, moved = px.mesh_shuffle_blocks(
+                    mesh, routed, coding=coding_info)
+                if coding_info is not None and not (
+                        px.last_info or {}).get("skipped"):
+                    # Counted only when the window actually crossed the
+                    # mesh: a mitigation-skipped window shuffled zero
+                    # bytes, so claiming coded "savings" there would
+                    # double-count what windows_skipped already reports.
+                    self.coded_exchange["windows"] += 1
+                    self.coded_exchange["raw_bytes"] += (
+                        coding_info["raw_bytes"])
+                    self.coded_exchange["coded_bytes"] += (
+                        coding_info["coded_bytes"])
                 for pid, blk in received:
                     out.add(pid, self.store.register(blk))
                 self.mesh_exchange_bytes += moved
@@ -2113,7 +2232,8 @@ class MTRunner(object):
         if fast is not None:
             return fast
         exchanged = self._mesh_exchange_entries(
-            entries, target=self._shuffle_targets.get(stage_id))
+            entries, target=self._shuffle_targets.get(stage_id),
+            reducer=stage.reducer)
         if exchanged is not None:
             entries = exchanged
         P = self.n_partitions
@@ -2329,7 +2449,7 @@ class MTRunner(object):
 
         n_maps = stage.options.get("n_maps", self.n_maps)
         results = self._pool_run(job, list(enumerate(chunks)), n_maps,
-                                 label="sink")
+                                 label="sink", speculative=False)
         paths = [p for p, _ in results]
         nrec = sum(n for _, n in results)
         return _SinkOutput(paths), nrec, len(chunks)
@@ -2444,6 +2564,12 @@ class MTRunner(object):
             # thread; hot sites hoist the None-check to one per job.
             self.profiler = _profile.Profiler(self.name)
             _profile.start(self.profiler)
+        if settings.mitigate_enabled():
+            # Straggler mitigation controller: live skew -> action.
+            # Every rank of a process group builds one and feeds it the
+            # same shared observations, so collective decisions agree.
+            self._mitigation = _mitigate.MitigationController(self.name)
+            _mitigate.start(self._mitigation)
         if interval > 0:
             from .obs.metrics import Metrics
             from .obs.sampler import Sampler
@@ -2502,6 +2628,8 @@ class MTRunner(object):
             _profile.stop(self.profiler)
         if self.flightrec is not None:
             _flightrec.stop(self.flightrec)
+        if self._mitigation is not None:
+            _mitigate.stop(self._mitigation)
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -2748,6 +2876,15 @@ class MTRunner(object):
         ex_delta = self._exchange_deltas()
         if ex_delta is not None:
             summary["mesh"]["exchange"].update(ex_delta)
+        if self.coded_exchange["windows"]:
+            # Coded-aggregation evidence: what the CAMR pre-fold traded
+            # (replicated map-side fold work) for (shuffle bytes).
+            ce = dict(self.coded_exchange)
+            ce["mode"] = str(settings.exchange_coding)
+            if ce["raw_bytes"]:
+                ce["savings_fraction"] = round(
+                    1.0 - ce["coded_bytes"] / float(ce["raw_bytes"]), 4)
+            summary["mesh"]["exchange"]["coding"] = ce
         summary.update({
             # Device execution: run-wide device counters — device_fraction
             # is thread-seconds inside ANY jitted kernel (lowered programs,
@@ -2782,6 +2919,24 @@ class MTRunner(object):
             "trace_file": None,
             "stats_file": None,
         })
+        if self._mitigation is not None:
+            # What the skew signal made the engine DO: speculative wins,
+            # stolen partitions, skipped collective windows, sticky
+            # down-weights.  Mirrored into the plan report (the
+            # mitigation is a runtime plan change) and — on merged
+            # multi-process runs — into stats()["fleet"]["mitigation"].
+            mit = self._mitigation.summary()
+            summary["mitigation"] = mit
+            plan_sec = summary.get("plan")
+            if isinstance(plan_sec, dict):
+                plan_sec["mitigation"] = {
+                    "engagements": mit["engagements"],
+                    "disengagements": mit["disengagements"],
+                    "windows_skipped": mit["windows_skipped"],
+                    "speculative_wins": mit["speculative_wins"],
+                    "stolen_partitions": mit["stolen_partitions"],
+                    "downweighted_ranks": mit["downweighted_ranks"],
+                }
         if self.metrics is not None:
             # Counters, gauge peaks/lasts, histogram summaries, and the
             # sampler's self-accounting (samples, series drops, the

@@ -498,6 +498,66 @@ max_quarantined = int(os.environ.get("DAMPR_TPU_MAX_QUARANTINED", "0"))
 exchange_timeout_ms = int(os.environ.get(
     "DAMPR_TPU_EXCHANGE_TIMEOUT_MS", "0"))
 
+#: Straggler mitigation (dampr_tpu.parallel.mitigate): when "on", every
+#: run starts a per-run mitigation controller that turns the live skew
+#: signal into action — work stealing from backlogged job queues,
+#: speculative re-execution of straggler jobs (first-result-wins under
+#: attempt-scoped commits), collective degrade-in-place when a rank is
+#: persistently late at exchange steps, and sticky partition-share
+#: down-weighting for pathological ranks.  "off" (the default) costs
+#: zero overhead: every mitigation site is one module-global None-check,
+#: the same contract as tracing/profiling.
+mitigate = os.environ.get("DAMPR_TPU_MITIGATE", "off")
+
+
+def mitigate_enabled():
+    return str(mitigate).lower() in ("on", "1", "true", "yes")
+
+
+#: Engagement threshold for the mitigation controller, two roles with
+#: one meaning ("this worker is this many times slower than its peers"):
+#: (a) a rank whose collective-step entry lateness is >= this multiple
+#: of the OTHER ranks' mean lateness plus the 20 ms jitter floor counts
+#: as pathological (deliberately not the reported ``late_ratio``, which
+#: saturates at the rank count — see mitigate.observe_window); (b) a
+#: host job whose elapsed time exceeds this multiple of the median
+#: completed job duration becomes a speculation candidate.
+speculate_threshold = float(os.environ.get(
+    "DAMPR_TPU_SPECULATE_THRESHOLD", "1.5"))
+
+#: Consecutive pathological observations before the mitigation engages
+#: (and consecutive healthy probe observations before it disengages).
+#: Twice this count of consecutive pathological observations escalates
+#: to the sticky down-weight (the rank's partition share is reduced for
+#: the remainder of the run).
+speculate_after_steps = int(os.environ.get(
+    "DAMPR_TPU_SPECULATE_AFTER", "3"))
+
+#: While the collective path is degraded, every this-many skipped
+#: windows one window runs through the mesh as a PROBE to re-measure
+#: skew — how a mitigation engaged for a transient slow spell
+#: (faults.py's windowed ``duration_ms`` slowness) disengages cleanly
+#: once the rank recovers.  0 disables probing (degrade becomes sticky
+#: for the run).
+mitigate_probe_windows = int(os.environ.get(
+    "DAMPR_TPU_MITIGATE_PROBE", "4"))
+
+#: CAMR-style coded aggregation for keyed folds routed over the byte
+#: exchange (arXiv 1901.07418): "camr" pre-folds each exchange window's
+#: blocks per destination partition under the stage's associative op —
+#: replicated map-side fold work traded for strictly fewer shuffle
+#: bytes (duplicate keys collapse before they cross the mesh).  Applies
+#: only where exactness is free: integer/bool lanes for sums (float
+#: summation order would change), any numeric lane for min/max.  "off"
+#: (default) ships every window's raw partials.  Byte-exactness against
+#: the uncoded path is pinned by tests.
+exchange_coding = os.environ.get("DAMPR_TPU_EXCHANGE_CODING", "off")
+
+
+def exchange_coding_enabled():
+    return str(exchange_coding).lower() in ("camr", "on", "1", "true")
+
+
 #: Whole-run retry budget for ``run(resume="auto")``: a failed run
 #: re-executes from its last durable checkpoint manifest up to this
 #: many times (transient-backoff between attempts; fatal failures and

@@ -688,7 +688,14 @@ class RunStore(object):
     def attempt(self):
         """Track every ref this thread registers inside the block; on
         exception the refs are dropped, so a retried job's failed attempt
-        cannot orphan blocks against the memory budget."""
+        cannot orphan blocks against the memory budget.
+
+        Attempts NEST: a successfully committed inner attempt merges its
+        refs into the enclosing frame, so an outer rollback still covers
+        them — the contract speculative job execution relies on (the
+        retry wrapper's per-attempt frame sits inside the speculation
+        layer's first-result-wins frame; a losing duplicate must roll
+        back everything its retries committed)."""
         stack = getattr(self._attempts, "stack", None)
         if stack is None:
             stack = self._attempts.stack = []
@@ -697,11 +704,14 @@ class RunStore(object):
         try:
             yield refs
         except BaseException:
+            stack.pop()
             for ref in refs:
                 self.drop_ref(ref)
             raise
-        finally:
+        else:
             stack.pop()
+            if stack:
+                stack[-1].extend(refs)
 
     def set_stage(self, stage_name):
         self._stage = "stage_{}".format(stage_name)

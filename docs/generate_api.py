@@ -60,6 +60,7 @@ MODULES = [
     "dampr_tpu.parallel.shuffle",
     "dampr_tpu.parallel.exchange",
     "dampr_tpu.parallel.replan",
+    "dampr_tpu.parallel.mitigate",
     "dampr_tpu.parallel.sgd",
     "dampr_tpu.native",
     "dampr_tpu.utils",

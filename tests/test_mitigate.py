@@ -1,0 +1,759 @@
+"""Straggler mitigation (dampr_tpu.parallel.mitigate): the controller
+state machine (engage after N pathological windows, probe cadence,
+clean disengage, sticky down-weight + deterministic weighted routing),
+first-result-wins exactly-once commits under racing duplicate attempts
+(including a loser completing AFTER the winner committed), work-stealing
+dispatch, end-to-end engine exactness with mitigation on, speculative
+re-execution of an injected straggler job, the CAMR coded-exchange
+exactness pin, the faults ``duration_ms`` windowed-slowness grammar,
+the zero-overhead disabled-path pin, and the doctor/history/schema
+surfaces."""
+
+import json
+import operator
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, faults, settings
+from dampr_tpu.parallel import mitigate
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_mitigate():
+    saved = (settings.mitigate, settings.speculate_threshold,
+             settings.speculate_after_steps,
+             settings.mitigate_probe_windows, settings.exchange_coding,
+             settings.mesh_fold, settings.mesh_exchange,
+             settings.small_stage_bytes, settings.max_processes,
+             settings.faults, settings.job_retries)
+    yield
+    (settings.mitigate, settings.speculate_threshold,
+     settings.speculate_after_steps, settings.mitigate_probe_windows,
+     settings.exchange_coding, settings.mesh_fold,
+     settings.mesh_exchange, settings.small_stage_bytes,
+     settings.max_processes, settings.faults,
+     settings.job_retries) = saved
+    faults.clear()
+    mitigate._active = None
+
+
+def _ctl(threshold=1.5, after=2, probe=3, run=None, skip_safe=True):
+    # skip_safe=True: unit tests exercise the degrade path directly;
+    # production resolves it from settings.exchange_timeout_ms (window
+    # skipping is only enabled under an armed exchange watchdog).
+    return mitigate.MitigationController(
+        run_name=run, threshold=threshold, after=after,
+        probe_every=probe, skip_safe=skip_safe)
+
+
+def _late(rank, seconds, healthy_rank=0):
+    """A 2-rank window observation: ``rank`` enters ``seconds`` late."""
+    out = {healthy_rank: 0.0, rank: seconds}
+    return out
+
+
+class TestControllerStateMachine:
+    def test_engages_after_consecutive_pathological_windows(self):
+        ctl = _ctl(after=3)
+        for i in range(2):
+            ctl.observe_window(_late(1, 0.4))
+            assert not ctl.engaged, i
+        ctl.observe_window(_late(1, 0.4))
+        assert ctl.engaged
+        assert ctl.engagements == 1
+        assert ctl.straggler == 1
+        assert ctl.last_late_ratio == pytest.approx(2.0)
+
+    def test_jitter_below_spread_floor_never_engages(self):
+        ctl = _ctl(after=1)
+        for _ in range(10):
+            # ratio is huge but the absolute spread is sub-floor noise
+            ctl.observe_window(_late(1, mitigate.MIN_SPREAD_S / 4))
+        assert not ctl.engaged and ctl.engagements == 0
+
+    def test_interrupted_streak_resets(self):
+        ctl = _ctl(after=3)
+        ctl.observe_window(_late(1, 0.4))
+        ctl.observe_window(_late(1, 0.4))
+        ctl.observe_window({0: 0.0, 1: 0.0})  # healthy window
+        ctl.observe_window(_late(1, 0.4))
+        ctl.observe_window(_late(1, 0.4))
+        assert not ctl.engaged
+
+    def test_probe_cadence_and_clean_disengage(self):
+        ctl = _ctl(after=2, probe=3)
+        for _ in range(2):
+            ctl.observe_window(_late(1, 0.4))
+        assert ctl.engaged
+        # While engaged: two skips then a probe, deterministic cadence.
+        decisions = [ctl.use_collective() for _ in range(6)]
+        assert decisions == [False, False, True, False, False, True]
+        assert ctl.windows_skipped == 4
+        # Healthy probes disengage after `after` consecutive ones.
+        ctl.observe_window({0: 0.0, 1: 0.0})
+        assert ctl.engaged
+        ctl.observe_window({0: 0.0, 1: 0.0})
+        assert not ctl.engaged
+        assert ctl.disengagements == 1
+        # Disengaged: every window crosses the mesh again.
+        assert all(ctl.use_collective() for _ in range(4))
+
+    def test_pathological_probe_keeps_it_engaged(self):
+        ctl = _ctl(after=2, probe=2)
+        for _ in range(2):
+            ctl.observe_window(_late(1, 0.4))
+        assert ctl.engaged
+        ctl.observe_window({0: 0.0, 1: 0.0})   # healthy probe #1
+        ctl.observe_window(_late(1, 0.4))      # still slow: streak resets
+        ctl.observe_window({0: 0.0, 1: 0.0})
+        assert ctl.engaged
+        ctl.observe_window({0: 0.0, 1: 0.0})
+        assert not ctl.engaged
+
+    def test_sticky_downweight_after_double_streak(self):
+        ctl = _ctl(after=2)
+        for _ in range(4):
+            ctl.observe_window(_late(1, 0.4))
+        assert ctl.engaged
+        assert ctl.downweights.get(1) is not None
+        w = ctl.downweights[1]
+        assert 0.25 <= w <= 0.75
+        # Sticky: recovery disengages but never removes the down-weight.
+        for _ in range(4):
+            ctl.observe_window({0: 0.0, 1: 0.0})
+        assert not ctl.engaged
+        assert ctl.downweights.get(1) == w
+        actions = [e["action"] for e in ctl.events]
+        assert actions.count("engage") == 1
+        assert actions.count("downweight") == 1
+        assert actions.count("disengage") == 1
+
+    def test_fault_rate_triggers_downweight_without_lateness(self):
+        ctl = _ctl(after=2)
+        bar = mitigate._FAULT_FACTOR
+        # Counts are CUMULATIVE; the controller differences them — a
+        # rank still absorbing >= _FAULT_FACTOR new retries per window
+        # stays pathological.
+        for w in range(1, 5):
+            ctl.observe_window({0: 0.0, 1: 0.0},
+                               fault_counts={0: 0, 1: bar * w})
+        assert 1 in ctl.downweights
+
+    def test_fault_burst_that_ends_goes_healthy_again(self):
+        """An old retry burst must not pin a recovered rank bad forever
+        — the cumulative count stops moving, the delta goes to zero,
+        and an engaged mitigation disengages."""
+        ctl = _ctl(after=2)
+        bar = mitigate._FAULT_FACTOR
+        ctl.observe_window({0: 0.0, 1: 0.0}, fault_counts={1: bar})
+        ctl.observe_window({0: 0.0, 1: 0.0}, fault_counts={1: 2 * bar})
+        assert ctl.engaged
+        # Burst over: the cumulative count freezes; deltas are 0.
+        ctl.observe_window({0: 0.0, 1: 0.0}, fault_counts={1: 2 * bar})
+        ctl.observe_window({0: 0.0, 1: 0.0}, fault_counts={1: 2 * bar})
+        assert not ctl.engaged
+        assert ctl.disengagements == 1
+
+    def test_route_table_weighted_and_deterministic(self):
+        ctl = _ctl(after=1)
+        assert ctl.route_table(8, 2) is None  # no down-weights yet
+        for _ in range(2):
+            ctl.observe_window(_late(1, 0.4))
+        table = ctl.route_table(8, 2)
+        assert table is not None
+        assert table == ctl.route_table(8, 2)  # cached + deterministic
+        counts = {d: table.count(d) for d in set(table)}
+        # rank 1 owns devices 4..7: down-weighted share is strictly
+        # smaller per device than rank 0's.
+        assert max(counts.get(d, 0) for d in (4, 5, 6, 7)) < counts[0]
+        assert set(table) == set(range(8))  # every device still serves
+
+    def test_skip_requires_armed_watchdog(self):
+        """Degrade-in-place is gated on the exchange watchdog: without
+        exchange_timeout_ms armed, an engaged controller never skips a
+        collective (a diverged skip would hang gloo unboundedly) —
+        stealing/speculation/down-weighting stay active."""
+        assert settings.exchange_timeout_ms == 0
+        ctl = mitigate.MitigationController(threshold=1.5, after=1)
+        assert ctl.skip_safe is False
+        for _ in range(4):
+            ctl.observe_window(_late(1, 0.4))
+        assert ctl.engaged
+        assert all(ctl.use_collective() for _ in range(6))
+        assert ctl.windows_skipped == 0
+        assert ctl.collective_fold_ok()  # fold declines only when safe
+        assert 1 in ctl.downweights     # down-weighting still engages
+        saved = settings.exchange_timeout_ms
+        settings.exchange_timeout_ms = 5000
+        try:
+            armed = mitigate.MitigationController(threshold=1.5, after=1)
+            assert armed.skip_safe is True
+        finally:
+            settings.exchange_timeout_ms = saved
+
+    def test_summary_shape(self):
+        ctl = _ctl(after=1)
+        ctl.observe_window(_late(1, 0.4))
+        ctl.note_steal()
+        ctl.note_speculation(win=True)
+        ctl.note_speculation(win=False)
+        s = ctl.summary()
+        assert s["enabled"] and s["engaged"]
+        assert s["stolen_partitions"] == 1
+        assert s["speculative_attempts"] == 2
+        assert s["speculative_wins"] == 1
+        assert s["straggler_rank"] == 1
+        assert json.dumps(s)  # JSON-safe
+
+    def test_events_land_in_faults_sidecar(self, tmp_path):
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            ctl = _ctl(after=1, run="mitrun")
+            for _ in range(2):
+                ctl.observe_window(_late(1, 0.5))
+            evs = faults.load_events("mitrun")
+            kinds = [(e["kind"], e.get("action")) for e in evs]
+            assert ("mitigation", "engage") in kinds
+            assert ("mitigation", "downweight") in kinds
+        finally:
+            settings.scratch_root = saved
+
+
+class TestFirstResultWinsExactlyOnce:
+    """The attempt-scoped-commit contract under racing duplicates: of N
+    attempts exactly one lands its registrations; every loser — even one
+    completing after the winner committed — rolls back."""
+
+    def _store(self, name):
+        from dampr_tpu import storage
+
+        return storage.RunStore(name)
+
+    def test_loser_completing_after_winner_rolls_back(self, tmp_path):
+        from dampr_tpu.blocks import Block
+
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            store = self._store("frw")
+            ctl = _ctl()
+            release = threading.Event()
+            calls = {"n": 0}
+            lock = threading.Lock()
+
+            def fn(job):
+                with lock:
+                    calls["n"] += 1
+                    attempt = calls["n"]
+                if attempt == 1:
+                    # Primary: wedged until AFTER the speculative
+                    # duplicate has committed.
+                    release.wait(timeout=30)
+                blk = Block.from_lists(list(range(64)), [1] * 64)
+                ref = store.register(blk)
+                return [ref]
+
+            results = {}
+
+            def primary():
+                results["out"] = mitigate.pool_dispatch(
+                    ctl, fn, [0], 1, store=store, speculative=False)
+
+            # Drive the two attempts by hand through the same claim
+            # machinery pool_dispatch uses: attempt A (slow) and
+            # attempt B (fast) race on one job.
+            committed = [False]
+            winner_refs, loser_rolled = [], []
+
+            def attempt(slow):
+                try:
+                    with store.attempt() as refs:
+                        if slow:
+                            release.wait(timeout=30)
+                        blk = Block.from_lists(list(range(64)), [1] * 64)
+                        store.register(blk)
+                        with lock:
+                            if committed[0]:
+                                raise mitigate._SpeculationLost()
+                            committed[0] = True
+                            winner_refs.extend(refs)
+                except mitigate._SpeculationLost:
+                    loser_rolled.append(True)
+
+            t_slow = threading.Thread(target=attempt, args=(True,))
+            t_fast = threading.Thread(target=attempt, args=(False,))
+            t_slow.start()
+            t_fast.start()
+            t_fast.join(timeout=30)
+            assert committed[0]
+            release.set()  # loser now completes, after the commit
+            t_slow.join(timeout=30)
+            assert loser_rolled == [True]
+            assert len(winner_refs) == 1
+            # Exactly the winner's block is store-resident: the loser's
+            # registration was rolled back without leaking budget.
+            assert len(store._resident) == 1
+            assert store._resident_bytes == winner_refs[0].nbytes
+        finally:
+            settings.scratch_root = saved
+
+    def test_speculative_dispatch_exactly_once_end_to_end(self, tmp_path):
+        from dampr_tpu.blocks import Block
+
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            store = self._store("frw2")
+            ctl = _ctl(threshold=1.5)
+            attempts = {"n": 0}
+            lock = threading.Lock()
+
+            def fn(job):
+                with lock:
+                    attempts["n"] += 1
+                if job == 7:
+                    with lock:
+                        first = attempts["n"] <= 8
+                    if first and not fn_fast[0]:
+                        time.sleep(1.0)  # the straggler's first attempt
+                blk = Block.from_lists([job] * 32, [1] * 32)
+                store.register(blk)
+                return job * 10
+
+            fn_fast = [False]
+            out = mitigate.pool_dispatch(ctl, fn, list(range(8)), 4,
+                                         store=store, speculative=True)
+            assert out == [j * 10 for j in range(8)]
+            # One committed registration per JOB regardless of how many
+            # attempts ran (speculation may or may not have fired on
+            # this box; the invariant is exactly-once either way).
+            assert len(store._resident) == 8
+        finally:
+            settings.scratch_root = saved
+
+    def test_randomized_exactly_once_property(self, tmp_path):
+        from dampr_tpu.blocks import Block
+
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        rng = np.random.RandomState(7)
+        try:
+            for round_i in range(5):
+                store = self._store("frwp{}".format(round_i))
+                ctl = _ctl(threshold=1.2)
+                delays = rng.uniform(0.0, 0.08, size=10)
+                delays[rng.randint(0, 10)] = 0.4  # one straggler
+
+                def fn(job, _d=delays):
+                    time.sleep(float(_d[job]))
+                    store.register(
+                        Block.from_lists([job] * 16, [1] * 16))
+                    return job
+
+                out = mitigate.pool_dispatch(
+                    ctl, fn, list(range(10)), 4, store=store,
+                    speculative=True)
+                assert out == list(range(10)), round_i
+                assert len(store._resident) == 10, (
+                    round_i, ctl.summary())
+        finally:
+            settings.scratch_root = saved
+
+    def test_primary_failure_with_winning_duplicate_succeeds(
+            self, tmp_path):
+        """A failure only counts once no attempt of the job can land a
+        result: the straggler's primary attempt dies while its
+        speculative duplicate is still running — the duplicate's commit
+        makes the dispatch succeed."""
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            store = self._store("frwpf")
+            ctl = _ctl(threshold=1.2)
+            attempts = {0: 0}
+            lock = threading.Lock()
+
+            def fn(job):
+                if job != 0:
+                    time.sleep(0.02)
+                    return job
+                with lock:
+                    attempts[0] += 1
+                    first = attempts[0] == 1
+                if first:
+                    time.sleep(0.4)       # straggle until the spec
+                    raise OSError("primary died late")
+                time.sleep(0.5)           # duplicate outlives the death
+                return 0
+
+            out = mitigate.pool_dispatch(ctl, fn, list(range(6)), 3,
+                                         store=store, speculative=True)
+            assert out == list(range(6))
+            assert ctl.speculative_wins >= 1, ctl.summary()
+        finally:
+            settings.scratch_root = saved
+
+    def test_job_failure_still_fails_dispatch(self, tmp_path):
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            store = self._store("frwf")
+            ctl = _ctl()
+
+            def fn(job):
+                if job == 3:
+                    raise ValueError("boom")
+                return job
+
+            with pytest.raises(ValueError):
+                mitigate.pool_dispatch(ctl, fn, list(range(6)), 3,
+                                       store=store, speculative=True)
+        finally:
+            settings.scratch_root = saved
+
+
+class TestWorkStealing:
+    def test_idle_workers_steal_from_backlogged_queue(self):
+        ctl = _ctl()
+        slow_worker_jobs = {0, 2, 4, 6}  # dealt to worker 0 of 2
+
+        def fn(job):
+            if job in slow_worker_jobs:
+                time.sleep(0.15)
+            return job
+
+        t0 = time.perf_counter()
+        out = mitigate.pool_dispatch(ctl, fn, list(range(8)), 2,
+                                     store=None, speculative=False)
+        wall = time.perf_counter() - t0
+        assert out == list(range(8))
+        assert ctl.stolen_partitions >= 1
+        # 4 slow jobs x 0.15s serial on one worker = 0.6s; stealing
+        # spreads them over 2 workers (generous bound for slow CI).
+        assert wall < 0.6
+
+
+class TestEngineEndToEnd:
+    def test_disabled_path_pin(self, tmp_path):
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        try:
+            assert not settings.mitigate_enabled()
+            em = (Dampr.memory([(i % 5, i) for i in range(500)],
+                               partitions=4)
+                  .group_by(lambda x: x[0])
+                  .reduce(lambda k, vs: len(list(vs)))
+                  .run(name="mit-off"))
+            assert mitigate.active() is None
+            assert "mitigation" not in em.stats()
+            em.delete()
+        finally:
+            settings.scratch_root = saved
+
+    def test_mitigated_run_byte_identical(self, tmp_path):
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        settings.max_processes = 4
+        try:
+            data = [((i * 7919) % 101, i) for i in range(4000)]
+
+            def pipe():
+                return (Dampr.memory(data, partitions=8)
+                        .map(lambda x: (x[0], x[1] * 3))
+                        .group_by(lambda x: x[0])
+                        .reduce(lambda k, vs: sorted(
+                            v[1] for v in vs)[:3]))
+
+            base = sorted(map(repr, pipe().run(name="mit-base").read()))
+            settings.mitigate = "on"
+            em = pipe().run(name="mit-on")
+            got = sorted(map(repr, em.read()))
+            s = em.stats()
+            assert got == base
+            assert s["mitigation"]["enabled"]
+            assert s["plan"]["mitigation"]["engagements"] == 0
+            em.delete()
+        finally:
+            settings.scratch_root = saved
+
+    def test_speculative_win_on_injected_straggler_job(self, tmp_path):
+        """One map job stalls 1.2s via the fault harness; with three
+        fast siblings done, an idle worker speculatively re-executes it
+        (the re-run's fault invocation has moved past the window) and
+        wins — results byte-identical to an uninjected run."""
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        settings.max_processes = 4
+        try:
+            data = [(i % 16, i) for i in range(8000)]
+
+            def pipe():
+                return (Dampr.memory(data, partitions=4)
+                        .map(lambda x: (x[0], x[1] + 1))
+                        .group_by(lambda x: x[0])
+                        .reduce(lambda k, vs: sum(v[1] for v in vs)))
+
+            base = sorted(pipe().run(name="spec-base").read())
+            settings.mitigate = "on"
+            settings.speculate_threshold = 1.5
+            # nth=1: exactly the first udf-batch invocation stalls —
+            # one straggler job; every other attempt runs clean.
+            settings.faults = "udf:nth=1,sleep_ms=1200"
+            em = pipe().run(name="spec-on")
+            got = sorted(em.read())
+            s = em.stats()
+            assert got == base
+            mit = s["mitigation"]
+            assert mit["speculative_attempts"] >= 1, mit
+            assert mit["speculative_wins"] >= 1, mit
+            em.delete()
+        finally:
+            settings.scratch_root = saved
+
+    def test_coded_exchange_byte_exact_and_fewer_bytes(self, tmp_path):
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        settings.mesh_fold = "off"
+        settings.mesh_exchange = "on"
+        settings.small_stage_bytes = 1024  # past the tiny-fold path
+        try:
+            data = [(i % 50, 1) for i in range(20000)]
+
+            def pipe():
+                return (Dampr.memory(data, partitions=8)
+                        .fold_by(lambda x: x[0], operator.add,
+                                 value=lambda x: x[1]))
+
+            base = sorted(pipe().run(name="coded-off").read())
+            settings.exchange_coding = "camr"
+            em = pipe().run(name="coded-on")
+            got = sorted(em.read())
+            s = em.stats()
+            assert got == base
+            cod = s["mesh"]["exchange"].get("coding")
+            assert cod is not None, s["mesh"]["exchange"]
+            assert cod["windows"] >= 1
+            assert cod["coded_bytes"] < cod["raw_bytes"]
+            assert 0.0 < cod["savings_fraction"] <= 1.0
+            # The plan report marks the armed mode.
+            assert (s["plan"].get("shuffle") or {}).get(
+                "coding") == "camr"
+            em.delete()
+        finally:
+            settings.scratch_root = saved
+
+    def test_coded_exchange_float_sum_ships_raw(self, tmp_path):
+        """Float sums are excluded from the pre-fold (summation order
+        would drift ulps): results still exact, no coded savings."""
+        saved = settings.scratch_root
+        settings.scratch_root = str(tmp_path)
+        settings.mesh_fold = "off"
+        settings.mesh_exchange = "on"
+        settings.small_stage_bytes = 1024
+        settings.exchange_coding = "camr"
+        try:
+            data = [(i % 10, 0.5) for i in range(20000)]
+            em = (Dampr.memory(data, partitions=4)
+                  .fold_by(lambda x: x[0], operator.add,
+                           value=lambda x: x[1])
+                  .run(name="coded-float"))
+            got = sorted(map(repr, em.read()))
+            assert got  # results materialized exactly
+            cod = em.stats()["mesh"]["exchange"].get("coding")
+            if cod is not None:
+                # windows may still count, but floats never fold:
+                assert cod["coded_bytes"] == cod["raw_bytes"]
+            em.delete()
+        finally:
+            settings.scratch_root = saved
+
+
+class TestFaultsDurationWindow:
+    def test_duration_window_expires(self):
+        rule = faults.SiteRule("exchange_step", sleep_ms=1,
+                               duration_ms=150, times=None)
+        assert rule.should_fire()        # inside the window
+        assert rule.should_fire()
+        time.sleep(0.2)
+        assert not rule.should_fire()    # window over: recovered
+        assert not rule.should_fire()
+
+    def test_duration_parses_and_describes(self):
+        p = faults.FaultPlan(
+            "exchange_step:rank=1,sleep_ms=400,every=2,duration_ms=5000")
+        r = p.rules["exchange_step"]
+        assert r.duration_ms == 5000 and r.sleep_ms == 400
+        assert r.describe()["duration_ms"] == 5000
+
+    def test_windowed_slow_site_end_to_end(self):
+        plan = faults.FaultPlan(
+            "fold:sleep_ms=30,duration_ms=120;seed=3")
+        faults.install(plan)
+        try:
+            t0 = time.perf_counter()
+            faults.check("fold")
+            first = time.perf_counter() - t0
+            assert first >= 0.025
+            time.sleep(0.15)
+            t0 = time.perf_counter()
+            faults.check("fold")
+            assert time.perf_counter() - t0 < 0.02
+        finally:
+            faults.clear()
+
+
+class TestSurfaces:
+    def test_new_knobs_exist_and_snapshot(self):
+        from dampr_tpu.obs import history
+
+        for knob in ("mitigate", "speculate_threshold",
+                     "speculate_after_steps", "mitigate_probe_windows",
+                     "exchange_coding"):
+            assert hasattr(settings, knob)
+            assert knob in history._KNOBS
+        snap = history._settings_snapshot()
+        assert snap["speculate_threshold"] == settings.speculate_threshold
+
+    def test_skew_playbook_names_mitigation_knobs(self):
+        from dampr_tpu.obs import doctor
+
+        knobs = [k for k, _e, _p, _w in doctor._PLAYBOOK["skew"]]
+        for knob in ("mitigate", "speculate_threshold",
+                     "speculate_after_steps", "exchange_coding"):
+            assert knob in knobs
+            assert hasattr(settings, knob)
+
+    def _mit_summary(self, engaged=True):
+        return {
+            "enabled": True, "engaged": False, "observations": 9,
+            "engagements": 1 if engaged else 0, "disengagements": 1,
+            "windows_skipped": 4, "speculative_attempts": 2,
+            "speculative_wins": 1, "stolen_partitions": 3,
+            "straggler_rank": 1, "last_late_ratio": 2.4,
+            "downweighted_ranks": {"1": 0.42}, "events": [],
+        }
+
+    def _fleet_summary(self, tmp_path, mitigation):
+        from dampr_tpu.obs import export
+
+        run = "mitdoc"
+        summary = {
+            "schema": export.STATS_SCHEMA, "run": run,
+            "process": {"process_id": 0, "num_processes": 2},
+            "started_at": 0.0, "wall_seconds": 10.0,
+            "n_partitions": 4, "stages": [
+                {"stage": 1, "kind": "reduce", "jobs": 2, "seconds": 9.0,
+                 "records_in": 10, "records_out": 5, "bytes_in": 100,
+                 "bytes_out": 50, "spill_count": 0, "spill_bytes": 0,
+                 "merge_gens": 0, "merge_gen_bytes": 0, "retries": 0,
+                 "quarantined": 0, "target": "host",
+                 "shuffle_target": None}],
+            "totals": {"records_out": 5, "bytes_out": 50,
+                       "spill_bytes": 0},
+            "fleet": {
+                "num_processes": 2, "ranks": [0, 1], "missing_ranks": [],
+                "alignment": "clock",
+                "per_rank": [{"rank": 0, "wall_seconds": 5.0},
+                             {"rank": 1, "wall_seconds": 10.0}],
+                "skew": {"steps": [{"step": 0}], "skew_seconds": 4.0,
+                         "max_fraction": 0.8, "mean_fraction": 0.6,
+                         "straggler_rank": 1,
+                         "mean_entry_lateness": {"0": 0.0, "1": 2.0},
+                         "late_ratio": 2.0},
+                "mitigation": mitigation,
+            },
+            "mitigation": mitigation,
+        }
+        tdir = os.path.join(str(tmp_path), run, "trace")
+        os.makedirs(tdir, exist_ok=True)
+        path = os.path.join(tdir, "stats.json")
+        with open(path, "w") as f:
+            json.dump(summary, f)
+        return path
+
+    def test_doctor_names_mitigation_in_skew_finding(self, tmp_path):
+        from dampr_tpu.obs import doctor
+
+        path = self._fleet_summary(tmp_path, self._mit_summary())
+        report = doctor.diagnose(path)
+        skews = [f for f in report["findings"]
+                 if f["bottleneck"] == "skew"]
+        assert skews, report["findings"]
+        assert "mitigation ACTED" in skews[0]["evidence"]
+        assert report["fleet"]["mitigation"]["engagements"] == 1
+        assert report["mitigation"]["stolen_partitions"] == 3
+        sugg = {s["setting"] for s in skews[0]["suggestions"]}
+        assert {"mitigate", "speculate_threshold",
+                "exchange_coding"} <= sugg
+        # Schema-valid report (mitigation shapes included).
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_doctor",
+            os.path.join(ROOT, "tools", "validate_doctor.py"))
+        vd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vd)
+        with open(os.path.join(ROOT, "docs",
+                               "doctor_schema.json")) as f:
+            schema = json.load(f)
+        errors = vd.validate(report, schema)
+        assert not errors, errors
+        # Human rendering names the mitigation.
+        text = doctor.format_report(report)
+        assert "mitigation" in text
+
+    def test_doctor_notes_armed_but_idle_mitigation(self, tmp_path):
+        from dampr_tpu.obs import doctor
+
+        path = self._fleet_summary(
+            tmp_path, self._mit_summary(engaged=False))
+        report = doctor.diagnose(path)
+        skews = [f for f in report["findings"]
+                 if f["bottleneck"] == "skew"]
+        assert skews and "never engaged" in skews[0]["evidence"]
+
+    def test_fleet_section_carries_mitigation(self):
+        from dampr_tpu.obs import fleet
+
+        mit = self._mit_summary()
+        ranks = {
+            0: {"dir": "/x", "trace": None,
+                "stats": {"process": {"num_processes": 2},
+                          "wall_seconds": 1.0, "mitigation": mit}},
+            1: {"dir": "/y", "trace": None,
+                "stats": {"process": {"num_processes": 2},
+                          "wall_seconds": 2.0}},
+        }
+        section = fleet.fleet_section(ranks, shifts={0: 0.0, 1: 0.0},
+                                      alignment="clock")
+        assert section["mitigation"] == mit
+
+    def test_straggler_of_matches_step_skew_definition(self):
+        from dampr_tpu.obs import fleet
+
+        r, ratio = fleet.straggler_of({0: 0.0, 1: 0.4})
+        assert r == 1 and ratio == pytest.approx(2.0)
+        r, ratio = fleet.straggler_of({})
+        assert r is None and ratio == 1.0
+
+    def test_replan_schedule_carries_coding(self):
+        from dampr_tpu.parallel import replan
+
+        coding = {"mode": "camr", "raw_bytes": 100, "coded_bytes": 40}
+        sched = replan.plan_exchange(
+            4, {(0, 1): 1000}, budget=1 << 20, coding=coding)
+        assert sched.coding == coding
+        assert replan.plan_exchange(4, {(0, 1): 10}).coding is None
+
+    def test_trace_schema_knows_mitigation_kind(self):
+        with open(os.path.join(ROOT, "docs",
+                               "trace_schema.json")) as f:
+            schema = json.load(f)
+        assert "mitigation" in schema["x-span-kinds"]

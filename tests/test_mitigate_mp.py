@@ -1,0 +1,154 @@
+"""Live straggler mitigation on a REAL 2-process gloo deployment
+(docs/robustness.md "Straggler mitigation"): rank 1 is injected slow at
+every collective exchange step for a bounded window
+(``exchange_step:rank=1,sleep_ms=...,duration_ms=...`` — the faults
+grammar's windowed slowness), and the contract holds end to end:
+
+- the controller ENGAGES after ``speculate_after_steps`` consecutive
+  late windows (entry times shared on the piggyback all_gather, aligned
+  on the ``mesh.clock_sync`` barrier clock);
+- engaged windows are degraded in place (skipped) with probe windows on
+  the configured cadence, every delivered window byte-identical to its
+  input (the host oracle — the exchange is a placement transport);
+- once the slow window expires the probes turn healthy and the
+  mitigation DISENGAGES cleanly; collectives resume;
+- both ranks' controllers traverse the identical state machine (the
+  shared-observation invariant that keeps skip decisions collective-
+  safe).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SLOW_MS = 250
+DURATION_MS = 2500
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, @ROOT@)
+    import numpy as np
+    from dampr_tpu import settings, faults
+    settings.scratch_root = os.path.join(
+        os.environ["MIT_SCRATCH"], "rank%d" % pid)
+    from dampr_tpu.parallel.mesh import init_distributed, data_mesh
+    init_distributed(coordinator_address="localhost:%s" % port,
+                     num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+    from dampr_tpu.parallel import exchange as px
+    from dampr_tpu.parallel import mitigate
+
+    mesh = data_mesh()
+    rng = np.random.RandomState(0)
+    blobs = {(s, d): rng.randint(0, 256, size=2048).astype(
+                 np.uint8).tobytes()
+             for s in range(8) for d in range(8) if s != d}
+
+    # Warm the collective programs BEFORE arming the slow site, so
+    # compile time never counts as lateness.
+    out = px.mesh_blob_exchange(mesh, blobs)
+    assert out == blobs, "warmup exchange not byte-identical"
+
+    # Window skipping requires the bounded-collective regime: arm the
+    # exchange watchdog (generous — nothing should ever hit it here).
+    settings.exchange_timeout_ms = 60000
+    ctl = mitigate.MitigationController(
+        run_name="mitmp", threshold=1.5, after=2, probe_every=2)
+    assert ctl.skip_safe
+    mitigate.start(ctl)
+    faults.configure(
+        "exchange_step:rank=1,sleep_ms=@SLOW_MS@,every=1,"
+        "duration_ms=@DURATION_MS@,times=1000")
+
+    engaged_seen = False
+    skipped_while_slow = 0
+    for w in range(60):
+        out = px.mesh_blob_exchange(mesh, blobs)
+        assert out == blobs, "window %d not byte-identical" % w
+        if ctl.engaged:
+            engaged_seen = True
+        if px.last_info.get("skipped"):
+            skipped_while_slow += 1
+        # Deterministic early exit: controller state is shared, so both
+        # ranks take the same branch (a one-sided exit would wedge the
+        # next collective forever).
+        if ctl.disengagements >= 1 and w >= 6:
+            break
+    # Post-recovery: two more windows cross the mesh normally.
+    for _ in range(2):
+        out = px.mesh_blob_exchange(mesh, blobs)
+        assert out == blobs
+        assert not px.last_info.get("skipped")
+
+    s = ctl.summary()
+    s["engaged_seen"] = engaged_seen
+    s["skipped_windows_seen"] = skipped_while_slow
+    mitigate.stop(ctl)
+    print("MITSUMMARY " + json.dumps(s, sort_keys=True), flush=True)
+""").replace("@ROOT@", repr(ROOT)).replace(
+    "@SLOW_MS@", str(SLOW_MS)).replace(
+    "@DURATION_MS@", str(DURATION_MS))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestLiveMitigation2Proc:
+    def test_engage_skip_probe_disengage_byte_identical(self, tmp_path):
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["MIT_SCRATCH"] = str(tmp_path / "scratch")
+        script = str(tmp_path / "worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(i), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                outs.append(p.communicate(timeout=240))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        for rank, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, (rank, out[-2000:], err[-4000:])
+        summaries = []
+        for rank, (out, _err) in enumerate(outs):
+            lines = [ln for ln in out.splitlines()
+                     if ln.startswith("MITSUMMARY ")]
+            assert lines, (rank, out[-2000:])
+            summaries.append(json.loads(lines[-1].split(" ", 1)[1]))
+        for rank, s in enumerate(summaries):
+            assert s["engaged_seen"], (rank, s)
+            assert s["engagements"] >= 1, (rank, s)
+            assert s["disengagements"] >= 1, (rank, s)
+            assert s["windows_skipped"] >= 1, (rank, s)
+            assert s["straggler_rank"] == 1, (rank, s)
+            assert not s["engaged"], (rank, s)  # ended disengaged
+        # Shared-observation invariant: both ranks' controllers walked
+        # the identical state machine.
+        keys = ("engagements", "disengagements", "windows_skipped",
+                "observations", "straggler_rank")
+        assert ({k: summaries[0][k] for k in keys}
+                == {k: summaries[1][k] for k in keys}), summaries
