@@ -41,6 +41,11 @@ def main():
                          "combine with DAMPR_TPU_MERGE_FANIN to force "
                          "in-run merge generations)")
     ap.add_argument("--dir", default="/tmp/dampr_tpu_bench")
+    ap.add_argument("--out", default=None,
+                    help="also write the sorted keys as text to this "
+                         "directory (one streaming part file) — the "
+                         "byte-exactness witness autotune sessions "
+                         "digest between trials")
     ap.add_argument("--progress", action="store_true",
                     help="live status line while the sort runs "
                          "(settings.progress)")
@@ -83,6 +88,10 @@ def main():
     out = runner.run([pipe.source])
 
     # vectorized order + count verification over sorted blocks
+    out_f = None
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        out_f = open(os.path.join(args.out, "sorted-part-0.txt"), "w")
     n = 0
     prev = None
     for blk in out[0].sorted_blocks():
@@ -93,7 +102,12 @@ def main():
             sys.exit(1)
         if len(ks):
             prev = ks[-1]
+        if out_f is not None and len(ks):
+            out_f.write("\n".join(map(str, ks)))
+            out_f.write("\n")
         n += len(ks)
+    if out_f is not None:
+        out_f.close()
     secs = time.time() - t0
     if n != expected:
         print("COMPLETENESS VIOLATION: {} != {}".format(n, expected),
@@ -175,6 +189,20 @@ def main():
         "plan_stages_before": (runner.plan_report or {}).get(
             "stages_before"),
         "plan_stages_after": (runner.plan_report or {}).get("stages_after"),
+        # Learned cost model (dampr_tpu.plan.model): where the sizing
+        # decisions came from (model / median-fallback / static) and the
+        # model's own throughput prediction — the perf gate's
+        # predicted-vs-measured residual check reads these.
+        "cost_source": ((runner.plan_report or {}).get("cost")
+                        or {}).get("source"),
+        "cost_choices_applied": sum(
+            1 for c in ((runner.plan_report or {}).get("cost")
+                        or {}).get("choices") or ()
+            if c.get("applied")),
+        "model_predicted_value": (((runner.plan_report or {}).get("cost")
+                                   or {}).get("predicted")
+                                  or {}).get("mbps"),
+        "n_partitions": runner.n_partitions,
         "trace_file": (runner.run_summary or {}).get("trace_file"),
     }))
 

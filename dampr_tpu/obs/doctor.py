@@ -893,7 +893,8 @@ def main(argv=None):
     ap.add_argument("run", help="run name, run scratch/trace directory, "
                                 "or stats.json path")
     ap.add_argument("runs", nargs="*",
-                    help="(with --diff) the second run")
+                    help="(with --diff) the second run; (with "
+                         "--autotune) the pipeline command, after --")
     ap.add_argument("--diff", action="store_true",
                     help="compare two runs: doctor --diff RUN_A RUN_B")
     ap.add_argument("--json", action="store_true",
@@ -903,8 +904,43 @@ def main(argv=None):
                     help="render the failure-recovery section: "
                          "classified retries, quarantine state, "
                          "injection plan, recorded exchange timeouts")
+    ap.add_argument("--autotune", action="store_true",
+                    help="closed-loop tuning: re-run the given pipeline "
+                         "command under model-suggested knob vectors, "
+                         "keep the fastest byte-identical winner, and "
+                         "persist it (docs/tuning.md): dampr-tpu-doctor "
+                         "RUN --autotune [--trials N] [--assert-dir D] "
+                         "[--report TUNE.json] -- CMD ...")
+    ap.add_argument("--trials", type=int, default=None,
+                    help="(--autotune) measured trial budget, baseline "
+                         "included (default settings.autotune_trials)")
+    ap.add_argument("--assert-dir", default=None,
+                    help="(--autotune) output directory whose content "
+                         "digest must match trial 0 for a trial to "
+                         "qualify (the byte-exactness witness)")
+    ap.add_argument("--report", default=None,
+                    help="(--autotune) write the schema-valid tuning "
+                         "report here")
+    # Everything after a literal ``--`` is the --autotune pipeline
+    # command, verbatim (argparse's own ``--`` handling cannot keep an
+    # option-looking command intact after optionals).
+    if argv is None:
+        argv = sys.argv[1:]
+    command = None
+    if "--" in argv:
+        split = list(argv).index("--")
+        command = list(argv[split + 1:])
+        argv = list(argv[:split])
     args = ap.parse_args(argv)
 
+    if args.autotune:
+        from . import autotune as _autotune
+
+        if command:
+            args.runs = command
+        return _autotune.main_autotune(args)
+    if command:
+        args.runs = (args.runs or []) + command
     try:
         if args.diff:
             if len(args.runs) != 1:

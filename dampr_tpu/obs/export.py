@@ -297,6 +297,23 @@ def format_summary(summary):
             line += "  · adaptive: {} change(s)".format(len(
                 ad.get("changes", ())))
         add(line)
+        cst = plan.get("cost") or {}
+        if cst.get("enabled"):
+            applied = [c for c in cst.get("choices") or ()
+                       if c.get("applied")]
+            line = "cost model: {} knob choice(s) applied".format(
+                len(applied))
+            for c in applied:
+                line += "  · {}: {} -> {}".format(
+                    c.get("knob"), c.get("static"), c.get("chosen"))
+            pred = cst.get("predicted") or {}
+            if pred.get("mbps"):
+                line += "  · predicted {} MB/s (static {})".format(
+                    pred["mbps"], pred.get("static_mbps"))
+            add(line)
+        elif cst.get("reason"):
+            add("cost model: {} (source {})".format(
+                cst["reason"], cst.get("source")))
     elif plan:
         add("plan: optimizer off (graph executed as constructed)")
     store = summary.get("store", {})

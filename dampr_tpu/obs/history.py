@@ -10,7 +10,9 @@ record to a bounded JSONL index under its scratch root::
     <scratch_root>/<run>/history.jsonl    # settings.history_entries cap
 
 Each line is one self-contained JSON record (schema
-``dampr-tpu-history/1``): the plan fingerprint + stage shapes (the
+``dampr-tpu-history/<version>`` — see :data:`SCHEMA_VERSION` and the
+tolerant :func:`upgrade` path for older lines): the plan fingerprint +
+stage shapes (the
 match key), per-stage IO measurements, critical-path verdicts
 (:mod:`.critpath`), the per-op profile when :mod:`.profile` was on,
 run throughput, and a snapshot of the performance-shaping settings.
@@ -26,8 +28,9 @@ Durability contract:
 
 Consumers: :func:`dampr_tpu.plan.cost.matched_history` (median over >= 3
 shape-matching runs, recency-bounded by ``settings.history_window``),
-``dampr-tpu-doctor`` (``--diff`` and trend context), and the ROADMAP
-item-5 learned cost model this corpus is the feedstock for.
+``dampr-tpu-doctor`` (``--diff`` and trend context), and the learned
+per-operator cost model (:mod:`dampr_tpu.plan.model`) whose feature
+extraction and knob-variance tables this corpus feeds.
 """
 
 import hashlib
@@ -41,8 +44,45 @@ from .. import settings
 
 log = logging.getLogger("dampr_tpu.obs.history")
 
-SCHEMA = "dampr-tpu-history/1"
+#: Current corpus record schema.  The version suffix is an integer so
+#: feature extraction (plan/model.py) can evolve without invalidating
+#: accumulated records: readers accept EVERY ``dampr-tpu-history/<=N``
+#: line and upgrade it in memory (:func:`upgrade`) — an old corpus
+#: degrades to thinner features, never to an empty history.
+SCHEMA_PREFIX = "dampr-tpu-history/"
+SCHEMA_VERSION = 2
+SCHEMA = SCHEMA_PREFIX + str(SCHEMA_VERSION)
 FILE = "history.jsonl"
+
+
+def schema_version(rec):
+    """The integer schema version of a record, or None when the schema
+    tag is missing/foreign/newer than this reader understands."""
+    tag = (rec or {}).get("schema")
+    if not isinstance(tag, str) or not tag.startswith(SCHEMA_PREFIX):
+        return None
+    try:
+        v = int(tag[len(SCHEMA_PREFIX):])
+    except ValueError:
+        return None
+    return v if 1 <= v <= SCHEMA_VERSION else None
+
+
+def upgrade(rec):
+    """In-memory upgrade of an older-version record to the current
+    feature surface.  v1 -> v2: per-stage ``shuffle_target`` (absent
+    pre-PR-12) defaults to None and the ``v`` field is stamped; the
+    record's on-disk line is never rewritten.  Tolerant: missing
+    containers become empty, never a raise."""
+    v = schema_version(rec) or 1
+    rec["v"] = v
+    if v < 2:
+        for st in rec.get("stages") or ():
+            if isinstance(st, dict):
+                st.setdefault("shuffle_target", None)
+        rec.setdefault("settings", {})
+        rec.setdefault("throughput", {})
+    return rec
 
 _append_lock = threading.Lock()
 
@@ -55,7 +95,8 @@ _KNOBS = ("partitions", "batch_size", "max_memory_per_stage",
           "exchange_min_bytes", "job_retries", "io_retries",
           "retry_backoff_ms", "max_quarantined", "exchange_timeout_ms",
           "mitigate", "speculate_threshold", "speculate_after_steps",
-          "mitigate_probe_windows", "exchange_coding")
+          "mitigate_probe_windows", "exchange_coding", "cost_model",
+          "autotune", "autotune_trials")
 
 
 def corpus_path(run_name):
@@ -88,10 +129,12 @@ def compact_record(summary):
     stages = []
     for st in summary.get("stages") or ():
         stages.append({k: st.get(k) for k in (
-            "stage", "kind", "target", "jobs", "records_in", "records_out",
-            "bytes_in", "bytes_out", "spill_bytes", "seconds")})
+            "stage", "kind", "target", "shuffle_target", "jobs",
+            "records_in", "records_out", "bytes_in", "bytes_out",
+            "spill_bytes", "seconds")})
     rec = {
         "schema": SCHEMA,
+        "v": SCHEMA_VERSION,
         "run": summary.get("run"),
         "ts": summary.get("started_at"),
         "wall_seconds": summary.get("wall_seconds"),
@@ -199,11 +242,11 @@ def _valid_line(line):
         rec = json.loads(line)
     except ValueError:
         return None
-    if not isinstance(rec, dict) or rec.get("schema") != SCHEMA:
+    if not isinstance(rec, dict) or schema_version(rec) is None:
         return None
     if not isinstance(rec.get("stages"), list):
         return None
-    return rec
+    return upgrade(rec)
 
 
 def load(run_name):

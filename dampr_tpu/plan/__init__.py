@@ -60,6 +60,7 @@ def empty_report(graph, enabled):
         "fused": [],
         "dead": [],
         "adaptive": {"applied": False, "reason": "disabled"},
+        "cost": cost.empty_cost_section("optimizer off"),
         "lowering": lower.empty_section(False),
         "shuffle": lower.empty_shuffle_section(False),
         "device_stages": 0,
@@ -89,6 +90,12 @@ def apply_to_runner(runner, outputs):
         graph, report = optimize(graph, outputs)
         runner.graph = graph
         cost.adapt(runner, graph, report)
+        # Learned-cost-model layer (plan/model.py): prices this plan
+        # with per-operator fits over the corpus and may override the
+        # median sizing; every choice + predicted-vs-static delta lands
+        # in report["cost"].  DAMPR_TPU_COST_MODEL=0 records the kill
+        # switch and leaves the median decisions untouched.
+        cost.apply_model(runner, graph, report)
     # Device lowering runs on BOTH legs (a placement decision over
     # whatever stage list executes, not a graph-shape rewrite): assign
     # each stage its execution target, stats history pinning tiny stages

@@ -29,12 +29,19 @@ defaults, untouched.  Every decision lands in the plan report's
 """
 
 import logging
+import os
 
 from .. import settings
 from ..graph import GMap, GReduce
 from . import ir
 
 log = logging.getLogger("dampr_tpu.plan.cost")
+
+
+def empty_cost_section(reason=None):
+    from . import model as _model
+
+    return _model.empty_section(False, reason=reason)
 
 
 def load_history(run_name):
@@ -99,7 +106,52 @@ def matched_history(run_name, graph):
     return hist
 
 
-def shuffle_choice(hist_stage, n_dev, n_partitions, mode=None):
+def current_model(run_name, graph):
+    """The fitted :class:`~dampr_tpu.plan.model.CostModel` for a run
+    name (knob-variance tables scoped to ``graph``'s fingerprint), or
+    None — model disabled (``DAMPR_TPU_COST_MODEL=0``), no corpus, or
+    any read failure (the model layer is best-effort by design)."""
+    if not settings.cost_model_enabled() or not run_name:
+        return None
+    try:
+        from ..obs import history
+        from . import model as _model
+
+        records = history.load(run_name)
+        if not records:
+            return None
+        fp = history.plan_fingerprint(ir.stage_shapes(graph))
+        return _model.build(records, fp)
+    except Exception:
+        log.debug("cost model unavailable for %r", run_name,
+                  exc_info=True)
+        return None
+
+
+def load_tuned(run_name):
+    """The persisted autotune winner for a run name
+    (``<scratch_root>/<run>/tuned.json``, written by
+    :mod:`dampr_tpu.obs.autotune`), or None.  Never raises."""
+    if not run_name:
+        return None
+    try:
+        import json
+
+        safe = str(run_name).replace("/", "_")
+        path = os.path.join(settings.scratch_root, safe, "tuned.json")
+        if not os.path.isfile(path):
+            return None
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except Exception:
+        log.debug("tuned.json unreadable for %r", run_name,
+                  exc_info=True)
+        return None
+
+
+def shuffle_choice(hist_stage, n_dev, n_partitions, mode=None,
+                   model=None):
     """(target, reason) — route one redistribution stage's shuffle over
     the ``host`` threadpool path or the ``mesh`` collective byte exchange
     (:mod:`dampr_tpu.parallel.exchange`).
@@ -128,6 +180,16 @@ def shuffle_choice(hist_stage, n_dev, n_partitions, mode=None):
     if not bytes_in:
         return "mesh", "{} devices visible, no shuffle history — the " \
             "budgeted collective engages by availability".format(n_dev)
+    if model is not None:
+        # Learned placement: when the corpus has fit BOTH the exchange
+        # and host-fold operator classes, modeled seconds decide the
+        # route instead of the static byte floor.  Unfit classes fall
+        # through to the heuristic below (and DAMPR_TPU_COST_MODEL=0
+        # never reaches here) — the kill switch reproduces the
+        # pre-model decisions byte-identically.
+        pred = model.shuffle_prediction(bytes_in / 1e6)
+        if pred is not None:
+            return pred
     if bytes_in < settings.exchange_min_bytes:
         return "host", (
             "history: {} B shuffle input < exchange_min_bytes={} — the "
@@ -243,3 +305,184 @@ def adapt(runner, graph, report):
                  len(info["changes"]), info["history"])
     else:
         info["reason"] = "within-defaults"
+
+
+def _hist_stage_rows(hist, graph):
+    """Shape-matched history stages annotated with op class and MB —
+    the feature rows the model search prices this plan with."""
+    from . import model as _model
+
+    shape_by_sid = {s["sid"]: s["shape"] for s in ir.stage_shapes(graph)}
+    rows = []
+    for st in (hist or {}).get("stages") or ():
+        row = dict(st)
+        row["op_class"] = _model.op_class(
+            st, shape_by_sid.get(st.get("stage")))
+        row["mb"] = max(st.get("bytes_in") or 0,
+                        st.get("bytes_out") or 0) / 1e6
+        rows.append(row)
+    return rows
+
+
+def model_view(run_name, graph, n_now=None):
+    """The shared ``corpus -> fits -> confidence -> choices`` pipeline
+    behind BOTH :func:`apply_model` (the decision) and ``explain()``'s
+    cost lines (the preview), so the rendered trace and the applied
+    decision cannot drift.  Returns a dict: ``records`` (rank-filtered
+    corpus), ``model`` (CostModel or None), ``rows`` (shape-matched
+    priced stages), ``ok``/``reason`` (confidence verdict),
+    ``partition_choice`` (vs ``n_now``, default the static
+    ``settings.partitions``), ``variance_choices``, ``tuned``,
+    ``fingerprint``."""
+    from ..obs import history
+    from . import model as _model
+
+    out = {"records": [], "model": None, "rows": [], "ok": False,
+           "reason": None, "partition_choice": None,
+           "variance_choices": [], "tuned": None, "fingerprint": None}
+    try:
+        records = [r for r in history.load(run_name)
+                   if not r.get("rank")]
+    except Exception:
+        log.debug("model corpus unreadable for %r", run_name,
+                  exc_info=True)
+        records = []
+    out["records"] = records
+    if not records:
+        out["reason"] = "no-history: empty corpus — static defaults " \
+            "stand"
+        return out
+    fp = history.plan_fingerprint(ir.stage_shapes(graph))
+    out["fingerprint"] = fp
+    m = _model.build(records, fp)
+    out["model"] = m
+    hist, hist_reason = corpus_history(run_name, graph)
+    rows = _hist_stage_rows(hist, graph) if hist else []
+    out["rows"] = rows
+    if not rows:
+        out["reason"] = "{}: no shape-matched measurements to price " \
+            "this plan with — median/static decisions stand".format(
+                hist_reason or "shape-mismatch")
+        return out
+    ok, why = m.confident_for([r["op_class"] for r in rows])
+    if not ok:
+        out["reason"] = "{} — median-path decisions stand".format(why)
+        return out
+    out["ok"] = True
+    out["partition_choice"] = _model.search_partitions(
+        m, rows, n_now if n_now is not None else settings.partitions)
+    current = {k: getattr(settings, k, None)
+               for k in _model.VARIANCE_KNOBS}
+    out["variance_choices"] = _model.search_variance_knobs(m, current)
+    out["tuned"] = load_tuned(run_name)
+    return out
+
+
+def apply_model(runner, graph, report):
+    """The learned-cost-model layer (:mod:`dampr_tpu.plan.model`): runs
+    AFTER the median-path adaptation and may override its sizing when
+    the per-operator fits are confident, recording every choice — and
+    its predicted-vs-static delta — in ``report["cost"]``.
+
+    Contract (pinned by tests): with ``DAMPR_TPU_COST_MODEL=0`` this
+    function records the kill switch and touches NOTHING — the median
+    path's decisions stand byte-identically.  An empty or thin corpus
+    likewise degrades to the median/static decisions with the reason
+    recorded."""
+    from . import model as _model
+
+    info = _model.empty_section(False)
+    report["cost"] = info
+    if not settings.cost_model_enabled():
+        info["reason"] = "disabled (settings.cost_model={!r} / " \
+            "DAMPR_TPU_COST_MODEL=0)".format(settings.cost_model)
+        return
+    if not settings.plan_adapt:
+        info["reason"] = "plan_adapt off — no history-driven decisions"
+        return
+    if getattr(runner, "resume", False):
+        info["reason"] = "resumable-run (re-sizing would orphan " \
+            "checkpoints)"
+        return
+    run_name = getattr(runner, "name", None)
+    if not run_name:
+        info["reason"] = "unnamed run — no corpus to learn from"
+        info["source"] = "static"
+        return
+    view = model_view(run_name, graph,
+                      n_now=getattr(runner, "n_partitions", None))
+    if view["model"] is not None:
+        info["model"] = view["model"].to_dict()
+    if not view["ok"]:
+        info["reason"] = view["reason"]
+        info["source"] = ("static" if not view["records"]
+                          else "median-fallback")
+        return
+    m, rows = view["model"], view["rows"]
+    info["enabled"] = True
+    info["source"] = "model"
+    choices = []
+
+    # -- partition count: argmin of modeled fold/exchange seconds -----------
+    tuned = view["tuned"]
+    if tuned and tuned.get("fingerprint") not in (None,
+                                                  view["fingerprint"]):
+        # A winner measured on a DIFFERENT plan shape under this run
+        # name: never apply it (fingerprint-less legacy files stay
+        # accepted).
+        info["tuned_stale"] = {"session": tuned.get("session"),
+                               "fingerprint": tuned["fingerprint"]}
+        tuned = None
+    tuned_knobs = (tuned or {}).get("knobs") or {}
+    if (not getattr(runner, "_explicit_partitions", True)
+            and not getattr(runner, "resume", False)):
+        n_now = runner.n_partitions
+        tuned_p = tuned_knobs.get("n_partitions")
+        if (isinstance(tuned_p, int)
+                and _model.in_bounds("n_partitions", tuned_p)
+                and tuned_p != n_now):
+            choices.append({
+                "knob": "n_partitions", "static": n_now,
+                "chosen": tuned_p, "applied": True,
+                "reason": "autotuned winner (tuned.json session {!r} "
+                          "measured it fastest)".format(
+                              (tuned or {}).get("session"))})
+            runner.n_partitions = tuned_p
+        else:
+            ch = view["partition_choice"]
+            if ch is not None:
+                ch["applied"] = True
+                runner.n_partitions = ch["chosen"]
+                choices.append(ch)
+
+    # -- run-level knobs: observed-variance choices (suggestions; the
+    #    engine never mutates process-global settings mid-run — the
+    #    autotune loop and the operator apply these via env) ---------------
+    for ch in view["variance_choices"]:
+        ch.setdefault("applied", False)
+        choices.append(ch)
+    info["choices"] = choices
+    if tuned:
+        info["tuned"] = {"session": tuned.get("session"),
+                         "knobs": tuned_knobs,
+                         "wall_seconds": tuned.get("wall_seconds")}
+
+    # -- headline prediction: modeled wall at the chosen vs static sizing --
+    basis_mb = max((r["mb"] for r in rows), default=0.0)
+    chosen_s = _model.predict_plan(m, rows, runner.n_partitions)
+    static_s = _model.predict_plan(m, rows, settings.partitions)
+    if chosen_s and static_s:
+        info["predicted"] = {
+            "wall_seconds": round(chosen_s, 4),
+            "static_wall_seconds": round(static_s, 4),
+            "mbps": (round(basis_mb / chosen_s, 3)
+                     if chosen_s > 0 else None),
+            "static_mbps": (round(basis_mb / static_s, 3)
+                            if static_s > 0 else None),
+        }
+    applied = [c for c in choices if c.get("applied")]
+    if applied:
+        log.info("plan: cost model applied %d knob choice(s): %s",
+                 len(applied),
+                 ", ".join("{}={}".format(c["knob"], c["chosen"])
+                           for c in applied))

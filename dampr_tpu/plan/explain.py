@@ -79,9 +79,59 @@ def explain_text(graph, outputs, name=None):
                     "    s{}: {}  {} rec / {} B out".format(
                         st.get("stage"), st.get("kind"),
                         st.get("records_out"), st.get("bytes_out")))
+    lines.extend(_cost_lines(optimized, name))
     lines.extend(_target_lines(optimized, name, outputs))
     lines.extend(_shuffle_lines(optimized, name, outputs))
     return "\n".join(lines)
+
+
+def _cost_lines(graph, name):
+    """The learned cost model's decision trace (docs/tuning.md),
+    rendered from the SAME ``cost.model_view`` pipeline apply_model
+    decides with — the preview and the decision cannot drift."""
+    if not settings.cost_model_enabled():
+        return ["cost model: off (settings.cost_model / "
+                "DAMPR_TPU_COST_MODEL=0) — median-path adaptation only"]
+    if not settings.plan_adapt:
+        return ["cost model: plan_adapt off — no history-driven "
+                "decisions"]
+    if not name:
+        return ["cost model: no run name — nothing learned yet"]
+    view = cost.model_view(name, graph)
+    m = view["model"]
+    if m is None:
+        return ["cost model: empty corpus for run {!r} — static "
+                "defaults stand".format(name)]
+    lines = ["cost model: {} corpus record(s), {} operator class(es) "
+             "fit".format(m.n_records, len(m.fits))]
+    for cls, f in sorted(m.fits.items()):
+        d = f.to_dict()
+        lines.append("  {:<9} {:>8} s/MB  {:>8} s/job  ({} pts, "
+                     "r2 {})".format(cls, d["secs_per_mb"],
+                                     d["secs_per_job"], d["points"],
+                                     d["r2"]))
+    if not view["ok"]:
+        lines.append("  abstaining: {}".format(view["reason"]))
+        return lines
+    ch = view["partition_choice"]
+    if ch is not None:
+        lines.append("  n_partitions: {} -> {}  (predicted {}s vs "
+                     "static {}s)".format(
+                         ch["static"], ch["chosen"],
+                         ch["predicted_seconds"], ch["static_seconds"]))
+    for c in view["variance_choices"]:
+        if c.get("chosen") != c.get("static"):
+            lines.append("  {}: {!r} -> {!r}  ({})".format(
+                c["knob"], c["static"], c["chosen"], c["reason"]))
+    tuned = view["tuned"]
+    if tuned:
+        stale = tuned.get("fingerprint") not in (None,
+                                                 view["fingerprint"])
+        lines.append("  autotuned winner on file: {} (session {!r}{})"
+                     .format(tuned.get("knobs"), tuned.get("session"),
+                             " — STALE: different plan shape, not "
+                             "applied" if stale else ""))
+    return lines
 
 
 def _shuffle_lines(graph, name, outputs=()):
@@ -106,7 +156,8 @@ def _shuffle_lines(graph, name, outputs=()):
             if d["target"] == "device" and d["kind"] == "reduce"}
     decisions = lower.shuffle_analyze(
         graph, cost.matched_history(name, graph) if name else None,
-        n_dev, settings.partitions, device_sids)
+        n_dev, settings.partitions, device_sids,
+        model=cost.current_model(name, graph) if name else None)
     if not decisions:
         return []
     n_mesh = sum(1 for d in decisions if d["target"] == "mesh")

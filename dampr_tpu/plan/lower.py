@@ -206,7 +206,7 @@ def _is_sort_stage(stage):
 
 
 def shuffle_analyze(graph, history, n_dev, n_partitions,
-                    device_sids=()):
+                    device_sids=(), model=None):
     """Per-redistribution-stage shuffle decisions:
     [{sid, kind, target, reason}].  Candidates are every GReduce (the
     group_by/fold_by/join exchange) and every sort re-key GMap (the
@@ -241,7 +241,7 @@ def shuffle_analyze(graph, history, n_dev, n_partitions,
                           "exchange"})
             continue
         target, reason = cost.shuffle_choice(
-            by_sid.get(sid), n_dev, n_partitions)
+            by_sid.get(sid), n_dev, n_partitions, model=model)
         decisions.append({"sid": sid, "kind": kind, "target": target,
                           "reason": reason})
     return decisions
@@ -276,7 +276,8 @@ def apply_shuffle(runner, report):
         if d["target"] == "device" and d["kind"] == "reduce"}
     decisions = shuffle_analyze(
         graph, history, n_dev if n_dev is not None else 2,
-        getattr(runner, "n_partitions", settings.partitions), device_sids)
+        getattr(runner, "n_partitions", settings.partitions), device_sids,
+        model=cost.current_model(getattr(runner, "name", None), graph))
     # Fault-history degrade: a stage whose collective exchange TIMED OUT
     # in a previous run under this name (a dead rank wedged the gloo
     # collective; the watchdog recorded the event before aborting) pins

@@ -51,8 +51,10 @@ batch_udf = os.environ.get("DAMPR_TPU_BATCH_UDF", "1") not in ("0", "false")
 #: Byte budget per stage for in-memory blocks before spilling to the next tier
 #: (replaces the reference's RSS-watermark `max_memory_per_worker`=512MB,
 #: settings.py:27 + memory.py — our block sizes are known, so accounting is
-#: deterministic, no /proc sampling).
-max_memory_per_stage = 512 * 1024 * 1024
+#: deterministic, no /proc sampling).  Env-settable so deployment configs
+#: (and autotune cold-config sessions) can pin it without code.
+max_memory_per_stage = int(os.environ.get(
+    "DAMPR_TPU_MEMORY_BUDGET", str(512 * 1024 * 1024)))
 
 # ---------------------------------------------------------------------------
 # TPU-native knobs (no reference analog)
@@ -322,6 +324,56 @@ plan_partition_bytes = int(os.environ.get(
     "DAMPR_TPU_PLAN_PARTITION_BYTES", str(32 * 1024 ** 2)))
 plan_block_bytes = int(os.environ.get(
     "DAMPR_TPU_PLAN_BLOCK_BYTES", str(8 * 1024 ** 2)))
+
+#: Learned per-operator cost model (dampr_tpu.plan.model, docs/tuning.md):
+#: "auto" (default) fits per-operator-class throughput regressors over the
+#: run-history corpus and uses them to SEARCH the knob space (partition
+#: count, per-stage batch sizes, merge fan-in, overlap windows, spill
+#: codec/threads, exchange budgets, shuffle placement) instead of replaying
+#: medians — every choice lands in the plan report's ``cost`` section with
+#: its predicted-vs-static delta.  "0"/"off" is the kill switch: the
+#: adaptation layer reproduces the pre-model median-path decisions
+#: byte-identically (pinned by tests).  Below the fit-confidence floor the
+#: model abstains and the median path stands, with the reason recorded.
+cost_model = os.environ.get("DAMPR_TPU_COST_MODEL", "auto")
+
+
+def cost_model_enabled():
+    return str(cost_model).lower() not in ("0", "false", "no", "off")
+
+
+#: Fit-confidence floor for the cost model: an operator class needs at
+#: least this many corpus measurements before its regressor participates,
+#: and the whole model abstains (median fallback, reason recorded) until
+#: the classes covering the plan's stages are all fit.
+cost_model_min_points = int(os.environ.get(
+    "DAMPR_TPU_COST_MODEL_MIN_POINTS", "3"))
+
+#: Minimum predicted improvement (fractional) before a model choice
+#: overrides the median/static decision — hysteresis so a noisy fit never
+#: flips knobs for sub-noise gains.
+cost_model_margin = float(os.environ.get(
+    "DAMPR_TPU_COST_MODEL_MARGIN", "0.02"))
+
+#: Closed-loop autotuning for bench drivers (dampr_tpu.obs.autotune):
+#: when "on", benches that honor it (bench_tfidf) re-run their measured
+#: pipeline under model-suggested knob vectors, keep the fastest
+#: byte-identical configuration, and persist the winner (tuned.json +
+#: the winner run's own corpus record) so the next fit sees it.  The
+#: unattended CLI form is ``dampr-tpu-doctor --autotune``.  "off"
+#: (default) = single-configuration runs, exactly as before.
+autotune = os.environ.get("DAMPR_TPU_AUTOTUNE", "off")
+
+
+def autotune_enabled():
+    return str(autotune).lower() in ("on", "1", "true", "yes")
+
+
+#: Trial budget for one autotune session (trial 0 is always the incoming
+#: baseline configuration; the remaining trials come from the model's
+#: knob search and the doctor playbook).  Bounded by construction: a
+#: session never runs more than this many measured executions.
+autotune_trials = int(os.environ.get("DAMPR_TPU_AUTOTUNE_TRIALS", "4"))
 
 #: Deterministic seeding for ``sample(prob)``: None (default) keeps the
 #: historical behavior — each worker thread draws from a time-seeded RNG,

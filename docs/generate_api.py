@@ -26,6 +26,7 @@ MODULES = [
     "dampr_tpu.plan.ir",
     "dampr_tpu.plan.passes",
     "dampr_tpu.plan.cost",
+    "dampr_tpu.plan.model",
     "dampr_tpu.plan.explain",
     "dampr_tpu.plan.lower",
     "dampr_tpu.runner",
@@ -49,6 +50,7 @@ MODULES = [
     "dampr_tpu.obs.critpath",
     "dampr_tpu.obs.history",
     "dampr_tpu.obs.doctor",
+    "dampr_tpu.obs.autotune",
     "dampr_tpu.resume",
     "dampr_tpu.settings",
     "dampr_tpu.ops.hashing",

@@ -29,6 +29,15 @@ better), and checks it against the best usable baseline::
 Secondary numeric keys shared by fresh and baseline (io_wait_fraction,
 spill MB/s, ...) are reported informationally, never gated.
 
+Autotune session reports (``TUNE_r*.json``, docs/doctor_schema.json's
+``autotune`` section) are accepted anywhere a baseline is: the winner
+trial's measured throughput is the comparable number.  Under ``--trend``
+a fresh record carrying the cost model's own prediction
+(``model_predicted_value``, emitted by the benches from the plan
+report's ``cost`` section) is also checked against it: a measured value
+more than the tolerance below the prediction prints a warn-only
+``MODEL WARN`` line (regression vs the learned fit, or a stale corpus).
+
 ``--trend`` additionally checks the whole baseline TRAJECTORY (pass the
 historical ``BENCH_r*.json`` files oldest-first): a best-of gate only
 catches a cliff, while a slow leak — each round a few percent under the
@@ -45,7 +54,10 @@ import sys
 
 def load_record(path):
     """A bench JSON file -> its payload dict (driver wrappers unwrapped,
-    non-dict payloads rejected)."""
+    non-dict payloads rejected).  Autotune session reports
+    (``TUNE_r*.json``, the doctor schema's ``autotune`` section) are
+    accepted as baselines: the winner trial's measured throughput is the
+    comparable number."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
@@ -53,6 +65,22 @@ def load_record(path):
     if not isinstance(doc, dict):
         raise ValueError("{}: bench record is not a JSON object".format(
             path))
+    v = doc.get("value")
+    if (isinstance(doc.get("autotune"), dict)
+            and (not isinstance(v, (int, float)) or isinstance(v, bool))):
+        # A session report with no headline value of its own (doctor
+        # --autotune output): the winner trial's measured throughput is
+        # the comparable number.  A record that already carries a
+        # numeric value — a fresh bench run with settings.autotune on,
+        # or a TUNE report stamped with one — is returned INTACT so
+        # none of its secondary keys (model_predicted_value, io shape)
+        # are lost.
+        winner = (doc["autotune"].get("winner") or {})
+        rec = {"metric": doc.get("metric"), "autotune": doc["autotune"]}
+        w = winner.get("mbps")
+        if isinstance(w, (int, float)) and not isinstance(w, bool):
+            rec["value"] = float(w)
+        return rec
     return doc
 
 
@@ -219,6 +247,27 @@ def main(argv=None):
     for n in report["notes"]:
         print("check_bench: note: {}".format(n))
     if args.trend:
+        # Model-residual check (docs/tuning.md): when the bench embedded
+        # the cost model's own throughput prediction, a measured number
+        # far below it means either a regression the corpus has not
+        # caught up with or a model gone stale — warn-only either way
+        # (a byte-based prediction and a wall-based measurement share a
+        # scale only approximately; tolerance absorbs that).
+        pred = fresh.get("model_predicted_value")
+        if (isinstance(pred, (int, float)) and not isinstance(pred, bool)
+                and pred > 0):
+            residual = (pred - report["fresh"]) / pred
+            if residual > args.tolerance:
+                print("check_bench: MODEL WARN: measured {:.4g} fell "
+                      "{:.1%} below the cost model's own prediction "
+                      "{:.4g} (tolerance {:.0%}) — regression vs the "
+                      "learned fit, or a stale corpus".format(
+                          report["fresh"], residual, float(pred),
+                          args.tolerance))
+            else:
+                print("check_bench: model residual {:+.1%} vs predicted "
+                      "{:.4g} (within {:.0%})".format(
+                          -residual, float(pred), args.tolerance))
         # Before the vacuous-pass early return: the trend check must run
         # even when nothing gates best-of (the BASELINE-only CI config).
         # A dedicated --trend-baseline pool never chains fresh onto it
