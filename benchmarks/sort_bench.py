@@ -32,6 +32,18 @@ def make_records(path, mb, seed=7):
             written += len(chunk)
 
 
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook: the external-sort pipeline shape
+    (constructed over this source file; nothing runs)."""
+    from dampr_tpu import Dampr
+    from dampr_tpu.ops.text import ParseNumbers
+
+    pipe = (Dampr.text(__file__, chunk_size=1024 ** 2)
+            .custom_mapper(ParseNumbers())
+            .checkpoint(force=True))
+    return [("sort_bench", pipe)]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mb", type=int, default=256)

@@ -1,0 +1,63 @@
+"""Static pipeline analysis: checked preconditions for the machinery that
+used to trust the user.
+
+The engine's highest-leverage passes all rest on UDF properties nothing
+verified: map fusion and checkpoint aliasing assume purity, speculative
+first-result-wins assumes determinism, coded/`a_group_by` aggregation
+assumes associative folds, and device lowering's vocabulary was a
+hand-maintained allowlist.  This package turns each assumption into a
+static verdict with evidence:
+
+- :mod:`.props` — UDF property classifier: bytecode inspection (global/
+  closure writes, I/O, ``time``/``random``/``uuid`` calls, unseeded RNG)
+  producing purity & determinism verdicts with the offending
+  instructions as evidence.  Evidence-based: a callable with no visible
+  hazard classifies pure/deterministic — the zero-false-positive
+  direction (suppressions exist for the rest, see docs/analysis.md).
+- :mod:`.pickleprobe` — dispatch-safety probe: every closure cell and
+  operator attribute must pickle (a process-pool/mesh deployment ships
+  them); failures name the exact closure variable instead of the raw
+  ``PicklingError`` traceback from deep inside a fork.
+- :mod:`.assoc` — fold-function associativity: recognized ``AssocOp``
+  kinds are associative by construction; opaque Python binops get a
+  randomized algebraic probe that hunts counterexample triples.
+- :mod:`.jaxtrace` — the DrJAX-style traceability probe (arXiv
+  2403.07128): numeric map/filter chains abstract-eval on
+  ``jax.ShapeDtypeStruct`` lanes; chains that trace are *certified*
+  device-lowerable and :mod:`dampr_tpu.plan.lower` widens its
+  vocabulary with them (ROADMAP item 5a).
+- :mod:`.validate` — the pre-flight plan validator: walks the stage IR
+  and emits coded diagnostics (``DTA...``, error/warn/info) for hazards
+  that today surface mid-run or never: impure UDFs in fused/speculated
+  stages, non-associative folds under combiner decomposition,
+  unpicklable closures headed for a multi-process dispatch,
+  fingerprint-unstable operators under ``resume=``/``cached()``.
+- :mod:`.lint` — the ``dampr-tpu-lint`` console script +
+  ``PBase.validate()`` surface (``--json`` validated by
+  ``docs/lint_schema.json``, same discipline as the doctor).
+
+Master switch: ``settings.analyze`` (env ``DAMPR_TPU_ANALYZE``; default
+on).  Off, every hook is a single flag check: plans, fingerprints, and
+results are byte-identical to the pre-analysis engine (CI pins it).
+"""
+
+from .. import settings
+
+
+def enabled():
+    """Is the analysis layer in force (settings.analyze)?"""
+    return settings.analyze
+
+
+from .assoc import classify_binop  # noqa: E402
+from .pickleprobe import probe_operator  # noqa: E402
+from .props import classify_callable, stage_verdict  # noqa: E402
+from .validate import (Diagnostic, PreflightError,  # noqa: E402
+                       preflight_dispatch_check, report_section,
+                       validate_graph)
+
+__all__ = [
+    "enabled", "classify_callable", "stage_verdict", "probe_operator",
+    "classify_binop", "Diagnostic", "PreflightError", "validate_graph",
+    "preflight_dispatch_check", "report_section",
+]

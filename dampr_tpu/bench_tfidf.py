@@ -149,6 +149,24 @@ def run_dampr_tpu(corpus, outdir):
     return secs, em.stats()
 
 
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook: the benchmark's pipeline shape
+    (constructed over this source file; nothing runs)."""
+    from dampr_tpu import Dampr
+    from dampr_tpu.ops.text import DocFreq
+
+    docs = Dampr.text(__file__, 1024 ** 2)
+    doc_freq = (docs.custom_mapper(
+        DocFreq(mode="word", lower=True, pair_values=False))
+        .fold_values(operator.add))
+    idf = doc_freq.cross_right(
+        docs.len(),
+        lambda df, total: (df[0], df[1],
+                           math.log(1 + (float(total) / df[1]))),
+        memory=True)
+    return [("bench_tfidf", idf.sink_tsv("/tmp/dampr_tpu_lint_idfs"))]
+
+
 def check_result(outdir, counter, total):
     got = {}
     for part in sorted(os.listdir(outdir)):

@@ -24,6 +24,11 @@ canonical workloads run from an installed package without a repo checkout.
   settings suggestions); ``--diff A B`` compares two runs, ``--json``
   emits the machine report (``docs/doctor_schema.json``).  See
   :mod:`dampr_tpu.obs.doctor`.
+- ``dampr-tpu-lint``   — static pre-flight diagnostics for pipeline
+  modules without executing them (UDF purity/determinism, dispatch
+  serialization, fold associativity, jax traceability); ``--json``
+  emits the machine report (``docs/lint_schema.json``).  See
+  :mod:`dampr_tpu.analyze.lint` and ``docs/analysis.md``.
 
 ``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` take ``--progress`` for the live
 in-run status line (``settings.progress``) and ``--explain`` to print the
@@ -146,6 +151,14 @@ def doctor():
     """Ranked bottleneck diagnosis for a completed run (see
     dampr_tpu.obs.doctor)."""
     from .obs.doctor import main
+
+    raise SystemExit(main())
+
+
+def lint():
+    """Static pre-flight diagnostics for pipeline modules (see
+    dampr_tpu.analyze.lint; docs/analysis.md)."""
+    from .analyze.lint import main
 
     raise SystemExit(main())
 

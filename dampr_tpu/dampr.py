@@ -33,6 +33,7 @@ import random
 import sys
 import threading
 import time
+import weakref
 
 from . import faults as _faults
 from . import settings
@@ -154,11 +155,18 @@ def _drive_runner(make_runner, sources, resume):
                 time.sleep(delay)
 
 
+#: Every live pipeline handle (weakly held).  ``dampr-tpu-lint`` uses
+#: this to discover the pipelines a linted module constructed at import
+#: time without running anything; the DSL itself never reads it.
+_live_handles = weakref.WeakSet()
+
+
 class PBase(object):
     def __init__(self, source, pmer):
         assert isinstance(source, Source)
         self.source = source
         self.pmer = pmer
+        _live_handles.add(self)
 
     def run(self, name=None, **kwargs):
         """Evaluate the composed graph; returns a ValueEmitter (its ``stats``
@@ -221,6 +229,25 @@ class PBase(object):
         from . import plan as _plan
 
         return _plan.explain_text(self.pmer.graph, [self.source], name=name)
+
+    def validate(self, resume=False, num_processes=1, probe=True):
+        """Pre-flight diagnostics for this pipeline — the
+        ``dampr-tpu-lint`` surface as an API (docs/analysis.md), WITHOUT
+        executing anything.  Returns the ordered diagnostic list
+        (:class:`dampr_tpu.analyze.Diagnostic`, errors first; empty =
+        clean).  Runs the full probe set — serialization, randomized
+        associativity, jax traceability — regardless of
+        ``settings.analyze``: an explicit call is its own opt-in.
+        ``num_processes > 1`` promotes unpicklable captures to errors
+        (rank dispatch WILL fail on them); ``resume=True`` adds the
+        checkpoint fingerprint-stability checks; ``probe=False`` keeps
+        it to the fast bytecode-only classification."""
+        from .analyze import validate as _av
+
+        return _av.validate_graph(
+            self.pmer.graph, resume=resume,
+            num_processes=num_processes, probe_traceable=probe,
+            probe_assoc=probe, probe_pickle=probe)
 
     def read(self, k=None, **kwargs):
         """Shorthand for run() + read()."""

@@ -88,7 +88,7 @@ _append_lock = threading.Lock()
 
 #: Settings whose values shape run performance: snapshotted per record so
 #: ``doctor --diff`` can attribute a regression to a config change.
-_KNOBS = ("partitions", "batch_size", "max_memory_per_stage",
+_KNOBS = ("analyze", "partitions", "batch_size", "max_memory_per_stage",
           "overlap_windows", "spill_write_threads", "spill_read_prefetch",
           "merge_fanin", "max_processes", "optimize", "profile",
           "mesh_exchange", "exchange_hbm_budget", "exchange_chunk_bytes",

@@ -145,6 +145,7 @@ class MitigationController(object):
         self.stolen_partitions = 0
         self.speculative_attempts = 0
         self.speculative_wins = 0
+        self.speculation_declined = []  # [{stage, evidence}] from analyze
         self.local_retries = 0
         self.events = []  # compact engage/disengage/downweight trail
 
@@ -366,6 +367,18 @@ class MitigationController(object):
         _metrics.counter_add("mitigation.speculative_wins" if win
                              else "mitigation.speculative_losses", 1)
 
+    def note_speculation_declined(self, stage, evidence):
+        """The static analyzer (dampr_tpu.analyze) refused speculative
+        re-execution for a stage: its UDFs are evidence-nondeterministic
+        and first-result-wins would silently commit whichever answer
+        happened to finish first.  Recorded so the doctor/fleet report
+        can say WHY a straggler stage saw no speculation."""
+        with self._lock:
+            rec = {"stage": stage, "evidence": list(evidence)[:3]}
+            if rec not in self.speculation_declined:
+                self.speculation_declined.append(rec)
+        _metrics.counter_add("mitigation.speculation_declined", 1)
+
     # -- reporting -----------------------------------------------------------
     def summary(self):
         """The ``stats()["mitigation"]`` section (rank 0's copy also
@@ -380,6 +393,8 @@ class MitigationController(object):
                 "windows_skipped": self.windows_skipped,
                 "speculative_attempts": self.speculative_attempts,
                 "speculative_wins": self.speculative_wins,
+                "speculation_declined": [dict(r) for r in
+                                         self.speculation_declined],
                 "stolen_partitions": self.stolen_partitions,
                 "straggler_rank": self.straggler,
                 "last_late_ratio": self.last_late_ratio,

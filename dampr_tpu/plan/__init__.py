@@ -63,6 +63,8 @@ def empty_report(graph, enabled):
         "cost": cost.empty_cost_section("optimizer off"),
         "lowering": lower.empty_section(False),
         "shuffle": lower.empty_shuffle_section(False),
+        "analysis": {"enabled": False, "stages": [], "diagnostics": [],
+                     "counts": {"error": 0, "warn": 0, "info": 0}},
         "device_stages": 0,
         "seconds": 0.0,
     }
@@ -106,6 +108,26 @@ def apply_to_runner(runner, outputs):
     # win, auto decides from the history corpus) the runner's dispatch
     # consults when it exchanges partitions.
     lower.apply_shuffle(runner, report)
+    # Static analysis (dampr_tpu.analyze, settings.analyze): per-stage
+    # purity/determinism verdicts + coded diagnostics over the stage
+    # list that will EXECUTE, recorded in the report's "analysis"
+    # section (rendered by explain(), shipped in stats()["plan"]).
+    # Fast bytecode-only classification here — the pickle probe and the
+    # randomized associativity probe run from validate()/lint (and the
+    # multi-process pre-flight check), not on every run.
+    if settings.analyze:
+        from ..analyze import validate as _av
+
+        try:
+            report["analysis"] = _av.report_section(
+                getattr(runner, "graph", graph),
+                probe_traceable=settings.lower_enabled())
+        except Exception:  # noqa: BLE001 - analysis never fails a run
+            report["analysis"] = _av.empty_section()
+    else:
+        report["analysis"] = {
+            "enabled": False, "stages": [], "diagnostics": [],
+            "counts": {"error": 0, "warn": 0, "info": 0}}
     # Shape records ride into stats.json so the NEXT run's cost layer can
     # match its plan against this run's measurements.
     report["stage_shapes"] = ir.stage_shapes(getattr(runner, "graph", graph))

@@ -38,6 +38,7 @@ def explain_text(graph, outputs, name=None):
                      "DAMPR_TPU_OPTIMIZE=0): the plan above executes as-is")
         lines.extend(_target_lines(graph, name, outputs))
         lines.extend(_shuffle_lines(graph, name, outputs))
+        lines.extend(_analysis_lines(graph))
         return "\n".join(lines)
     optimized, report = passes.optimize(graph, outputs)
     lines.append("== optimized plan ({} executed) =="
@@ -82,7 +83,44 @@ def explain_text(graph, outputs, name=None):
     lines.extend(_cost_lines(optimized, name))
     lines.extend(_target_lines(optimized, name, outputs))
     lines.extend(_shuffle_lines(optimized, name, outputs))
+    lines.extend(_analysis_lines(optimized))
     return "\n".join(lines)
+
+
+def _analysis_lines(graph):
+    """The static analyzer's verdict summary (dampr_tpu.analyze): one
+    property line per executed stage plus every coded diagnostic —
+    the same records the run ships in ``stats()["plan"]["analysis"]``."""
+    if not settings.analyze:
+        return ["analysis: off (settings.analyze / DAMPR_TPU_ANALYZE=0)"]
+    from ..analyze import validate as _av
+
+    sec = _av.report_section(graph,
+                             probe_traceable=settings.lower_enabled())
+    c = sec["counts"]
+    lines = ["analysis: {} stage(s) classified — {} error(s), {} "
+             "warning(s), {} info".format(
+                 len(sec["stages"]), c["error"], c["warn"], c["info"])]
+    for st in sec["stages"]:
+        marks = []
+        if not st["pure"]:
+            marks.append("impure")
+        if not st["deterministic"]:
+            marks.append("nondet")
+        fold = st.get("fold_assoc")
+        if fold is not None:
+            marks.append("fold-assoc:" + fold["assoc"])
+        if st.get("traceable"):
+            marks.append("jax-traceable (certified)")
+        lines.append("  s{}: {}  [{}]".format(
+            st["sid"], st["stage"],
+            ", ".join(marks) if marks else "pure, deterministic"))
+    for d in sec["diagnostics"]:
+        lines.append("  {}: {} s{} {}".format(
+            d["severity"], d["code"], d["sid"], d["message"]))
+        for e in d["evidence"][:3]:
+            lines.append("      - {}".format(e))
+    return lines
 
 
 def _cost_lines(graph, name):

@@ -118,6 +118,19 @@ def _map_decision(stage, graph, protected):
     head, tail = leaves[0], leaves[1:]
     params = ops_lower.claims(head)
     if params is None:
+        # Widened vocabulary (ROADMAP 5a): a chain the static analyzer
+        # certifies jax-traceable (pure deterministic ValueMap/Filter
+        # lane ops that abstract-eval cleanly) lowers as a vectorized
+        # lane program — exactness-gated per block at dispatch, the
+        # per-record path the guaranteed fallback.  A certified chain's
+        # record multiplicity and grouping are identical to the host
+        # path, so no combiner/consumer granularity constraints apply.
+        if settings.analyze:
+            from ..analyze import jaxtrace
+
+            spec, why = jaxtrace.chain_claims(stage.mapper)
+            if spec is not None:
+                return "device", why + " (verified-per-block lane program)"
         name = ir._part_name(head)
         return "host", "no device lowering for {} (opaque UDF)".format(name)
     bad = [p for p in tail if not (type(p) is base.Map

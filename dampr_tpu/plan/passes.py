@@ -62,6 +62,30 @@ def _dead_stage_elimination(stages, outputs, report):
     return [s for i, s in enumerate(stages) if keep[i]]
 
 
+def _impure_blocks_compose(*stages):
+    """Does the static analyzer (settings.analyze) veto composing these
+    stages' record chains into one stage?  An evidence-impure UDF keeps
+    its own stage: fusing it would move its side effects into another
+    stage's job/retry/checkpoint scope (a retried fused job replays the
+    OTHER stage's side effects too, and a checkpoint alias over the
+    fused node may skip them entirely).  ``assume_pure=True`` stage
+    options suppress (honored inside stage_verdict).  Identity
+    dissolves never consult this — they leave the surviving mapper
+    untouched."""
+    if not settings.analyze:
+        return False
+    from ..analyze import props
+
+    for s in stages:
+        try:
+            if not props.stage_verdict(s).pure:
+                return True
+        except Exception:  # noqa: BLE001 - analysis never fails a plan
+            continue  # unclassifiable stage: benefit of the doubt,
+            #           but keep checking the OTHER stages
+    return False
+
+
 def _fusable_pair(a, b, counts, protected):
     """May GMap ``b`` absorb its producer GMap ``a``?  Returns the rule
     name ('fuse_maps' / 'hoist_combiners') or None.
@@ -84,6 +108,8 @@ def _fusable_pair(a, b, counts, protected):
         # vectorized map_blocks / window_sink paths — is untouched.
         return "hoist_combiners" if ir.has_combiner(b) else "fuse_maps"
     if ir.is_record_chain(a.mapper) and ir.is_record_chain(b.mapper):
+        if _impure_blocks_compose(a, b):
+            return None
         return "fuse_maps"
     return None
 
@@ -132,7 +158,8 @@ def _fuse_maps(stages, protected, report):
                     and counts.get(a.output, 0) == 1
                     and not ir.has_combiner(a)
                     and ir.is_record_chain(a.mapper)
-                    and ir.is_record_chain(b.sinker)):
+                    and ir.is_record_chain(b.sinker)
+                    and not _impure_blocks_compose(a)):
                 rule = "fuse_sinks"
                 fused = GSink(a.inputs, b.output,
                               ir.compose_mappers(a.mapper, b.sinker),

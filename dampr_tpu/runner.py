@@ -851,6 +851,31 @@ class MTRunner(object):
         self._run_failed = False
 
     # -- job fan-out --------------------------------------------------------
+    def _speculation_ok(self, *stages):
+        """May these stages' jobs be speculatively re-executed?  The
+        static analyzer (settings.analyze) declines speculation for any
+        stage holding an evidence-nondeterministic UDF — first-result-
+        wins over a nondeterministic function commits whichever answer
+        finished first, silently.  Only consulted when the mitigation
+        controller is armed (the default path stays one None-check);
+        ``assume_deterministic=True`` stage options suppress."""
+        if not settings.analyze or _mitigate.active() is None:
+            return True
+        from .analyze import props
+
+        for stage in stages:
+            try:
+                v = props.stage_verdict(stage)
+            except Exception:  # noqa: BLE001 - analysis never fails a run
+                continue
+            if not v.deterministic:
+                ctl = _mitigate.active()
+                if ctl is not None:
+                    ctl.note_speculation_declined(
+                        v.name, v.nondet_evidence)
+                return False
+        return True
+
     def _pool_run(self, fn, jobs, n_workers, label=None, speculative=True):
         retries = settings.job_retries
         if retries:
@@ -1007,7 +1032,8 @@ class MTRunner(object):
             stage, supplementary)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
-        results = self._pool_run(job, chunks, n_maps, label="map")
+        results = self._pool_run(job, chunks, n_maps, label="map",
+                                 speculative=self._speculation_ok(stage))
         pset = self._collect_partitions(results, combine_op, pin,
                                         feeds_reduce, device=feeds_dev,
                                         sorted_runs=run_mode)
@@ -1246,7 +1272,8 @@ class MTRunner(object):
         # so a stage that asked to serialize stays serialized when fused.
         n_maps = min(s.options.get("n_maps", self.n_maps) for s in stages)
         results = self._pool_run(group_job, chunks, n_maps,
-                                 label="map-group")
+                                 label="map-group",
+                                 speculative=self._speculation_ok(*stages))
 
         ret = []
         for i in range(len(stages)):
@@ -1402,10 +1429,21 @@ class MTRunner(object):
         # mapper so a stale/foreign annotation can never dispatch an
         # unrecognized op — the host path below is the guaranteed fallback.
         dev_lowered = False
+        lane_program = None
         if stage.options.get("exec_target") == "device":
             from .ops import lower as ops_lower
 
             dev_lowered = ops_lower.claims(stage.mapper) is not None
+            if not dev_lowered:
+                # Certified numeric UDF chain (analyze.jaxtrace, the
+                # widened ROADMAP-5a vocabulary): the batched-UDF path
+                # below runs whole batches through one vectorized lane
+                # program.  stage_program re-certifies the chain, so a
+                # stale/foreign annotation can never dispatch an
+                # unknown op; non-numeric batches fall back per batch.
+                from .analyze import jaxtrace as _jaxtrace
+
+                lane_program = _jaxtrace.stage_program(stage)
 
         def window_sink():
             """The stage's window sink honoring its execution target
@@ -1559,9 +1597,49 @@ class MTRunner(object):
 
                 fa = _faults.active()
                 if quarantine is None and fa is None:
-                    # The hot default: straight through, zero added cost.
-                    for ks, vs in batches:
-                        run_chain(ks, vs, 0, emit)
+                    prog = lane_program
+                    if prog is None:
+                        # The hot default: straight through, zero added
+                        # cost.
+                        for ks, vs in batches:
+                            run_chain(ks, vs, 0, emit)
+                    else:
+                        # Certified lane program: whole batches evaluate
+                        # vectorized (64-bit host authority; device
+                        # dispatch verified per batch inside run_batch).
+                        # The FIRST vectorized batch of each job is
+                        # additionally differential-tested against the
+                        # per-record chain — a divergence (int64 wrap,
+                        # a dtype-sensitive UDF) drops the job back to
+                        # the authoritative per-record path for good.
+                        diffed = False
+                        for ks, vs in batches:
+                            out = (prog.run_batch(ks, vs)
+                                   if prog is not None else None)
+                            if out is not None and not diffed:
+                                diffed = True
+                                staged = []
+                                run_chain(ks, vs, 0,
+                                          lambda a, b:
+                                          staged.append((a, b)))
+                                rks = [k for a, _ in staged for k in a]
+                                rvs = [v for _, b in staged for v in b]
+                                prog.count("diff_checked")
+                                if rks != out[0] or rvs != out[1]:
+                                    prog.count("diff_diverged")
+                                    log.warning(
+                                        "lane program diverged from the "
+                                        "per-record chain on its first "
+                                        "batch (%s); job falls back to "
+                                        "the per-record path",
+                                        prog.spec.describe())
+                                    prog = None
+                                    emit(rks, rvs)
+                                    continue
+                            if out is None:
+                                run_chain(ks, vs, 0, emit)
+                            else:
+                                emit(*out)
                 else:
                     # Poison-record quarantine (and/or fault injection):
                     # each input batch runs TRANSACTIONALLY — outputs
@@ -2394,7 +2472,8 @@ class MTRunner(object):
         n_reducers = stage.options.get("n_reducers", self.n_reducers)
         try:
             results = self._pool_run(job, list(range(P)), n_reducers,
-                                     label="reduce")
+                                     label="reduce",
+                                     speculative=self._speculation_ok(stage))
         finally:
             if exchanged is not None:
                 # The exchanged copies are intermediates private to this
@@ -2670,6 +2749,20 @@ class MTRunner(object):
         # the report records either way).  Before obs setup: stage counts
         # and resume fingerprints must see the final graph.
         _plan.apply_to_runner(self, outputs)
+        # Pre-flight dispatch check (analyze.validate): on a multi-rank
+        # deployment an unpicklable UDF capture WILL fail at a process
+        # boundary (checkpoint manifests, quarantine audit lines, the
+        # exchange's pickled lanes) — fail here with a diagnostic naming
+        # the stage, the UDF, and the closure variable instead of a raw
+        # PicklingError traceback from deep inside the dispatch.
+        if settings.analyze:
+            from .parallel.mesh import rank_info
+
+            nproc = rank_info()[1]
+            if nproc > 1:
+                from .analyze import validate as _av
+
+                _av.preflight_dispatch_check(self.graph, nproc)
         # Fault plan (settings.faults): a fresh per-run schedule so chaos
         # runs replay identically; the counter epoch scopes the
         # stats()["faults"] section to THIS run.

@@ -303,6 +303,20 @@ def _env_flag(name):
 
 optimize = _env_flag("DAMPR_TPU_OPTIMIZE")
 
+#: Static pipeline analysis (dampr_tpu.analyze, docs/analysis.md): UDF
+#: purity/determinism classification, dispatch-safety (pickle) probes,
+#: fold associativity recognition, and the jax-traceability probe that
+#: widens device lowering to certified numeric UDF chains.  On (the
+#: default), every run's plan report carries an ``analysis`` section,
+#: fusion declines to fuse across evidence-impure UDFs, speculation
+#: declines on nondeterministic UDFs, multi-process dispatch of
+#: unpicklable closures fails pre-flight with a named diagnostic, and
+#: certified numeric chains become device-lowerable.  Off
+#: (``DAMPR_TPU_ANALYZE=0``), every hook is one flag check and plans,
+#: fingerprints, and results are byte-identical to the pre-analysis
+#: engine (CI pins it).
+analyze = _env_flag("DAMPR_TPU_ANALYZE")
+
 #: Per-rule kill switches (all default on; only consulted when
 #: ``optimize`` is on).  plan_fuse: compose chains of pure per-record map
 #: stages; plan_hoist: dissolve identity+combiner stages into their

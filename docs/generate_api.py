@@ -51,6 +51,13 @@ MODULES = [
     "dampr_tpu.obs.history",
     "dampr_tpu.obs.doctor",
     "dampr_tpu.obs.autotune",
+    "dampr_tpu.analyze",
+    "dampr_tpu.analyze.props",
+    "dampr_tpu.analyze.pickleprobe",
+    "dampr_tpu.analyze.assoc",
+    "dampr_tpu.analyze.jaxtrace",
+    "dampr_tpu.analyze.validate",
+    "dampr_tpu.analyze.lint",
     "dampr_tpu.resume",
     "dampr_tpu.settings",
     "dampr_tpu.ops.hashing",

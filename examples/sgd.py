@@ -60,6 +60,37 @@ def dsl_gradient(pipe, w, b):
     return gw / n, gb / n
 
 
+def build_gradient_pipeline(X, y, w, b):
+    """One gradient evaluation as a handle (nothing runs until read)."""
+    def partial_grads(rows):
+        gw = np.zeros_like(w)
+        gb = 0.0
+        n = 0
+        for x, yv in rows:
+            logit = float(x @ w + b)
+            s = 1.0 / (1.0 + np.exp(-logit))
+            gw += (s - yv) * x
+            gb += s - yv
+            n += 1
+        yield 1, (gw, gb, n)
+
+    def add3(a, c):
+        return (a[0] + c[0], a[1] + c[1], a[2] + c[2])
+
+    return (Dampr.memory(list(zip(X, y)), partitions=8).cached()
+            .partition_map(partial_grads)
+            .fold_by(lambda _x: 1, add3, lambda x: x))
+
+
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook (docs/analysis.md)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    w = np.zeros(4, dtype=np.float32)
+    return [("sgd_gradient", build_gradient_pipeline(X, y, w, 0.0))]
+
+
 def main(n=4096, f=64, steps=10):
     rng = np.random.RandomState(0)
     X = rng.randn(n, f).astype(np.float32)

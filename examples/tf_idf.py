@@ -37,7 +37,8 @@ def doc_freq_vectorized(docs):
             .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
 
 
-def main(fname, parity=False):
+def build(fname, parity=False, out="/tmp/dampr_tpu_idfs"):
+    """The full TF-IDF pipeline handle, sink attached, nothing run."""
     chunk_size = os.path.getsize(fname) // multiprocessing.cpu_count() + 1 \
         if os.path.isfile(fname) else 16 * 1024 ** 2
     docs = Dampr.text(fname, chunk_size)
@@ -48,9 +49,18 @@ def main(fname, parity=False):
         docs.len(),
         lambda d, total: (d[0], d[1], math.log(1 + float(total) / d[1])),
         memory=True)
+    return idf.sink_tsv(out)
 
+
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook (docs/analysis.md)."""
+    return [("tfidf_vectorized", build(__file__)),
+            ("tfidf_parity", build(__file__, parity=True))]
+
+
+def main(fname, parity=False):
     out = "/tmp/dampr_tpu_idfs"
-    idf.sink_tsv(out).run(name="tf-idf")
+    build(fname, parity, out).run(name="tf-idf")
     print("wrote idf TSV parts under", out)
     with open(os.path.join(out, sorted(os.listdir(out))[0])) as f:
         for line in list(f)[:5]:

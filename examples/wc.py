@@ -13,10 +13,21 @@ import sys
 from dampr_tpu import Dampr, setup_logging
 
 
+def build(path, chunk_mb=16):
+    """The word-count pipeline handle (nothing executes until run())."""
+    return (Dampr.text(path, chunk_size=chunk_mb * 1024 ** 2)
+            .flat_map(lambda line: line.split())
+            .fold_by(lambda w: w, binop=lambda x, y: x + y,
+                     value=lambda w: 1))
+
+
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook (docs/analysis.md)."""
+    return [("wc", build(__file__))]
+
+
 def main(path, chunk_mb=16):
-    wc = (Dampr.text(path, chunk_size=chunk_mb * 1024 ** 2)
-          .flat_map(lambda line: line.split())
-          .fold_by(lambda w: w, binop=lambda x, y: x + y, value=lambda w: 1))
+    wc = build(path, chunk_mb)
 
     results = wc.run("word-count")
     for word, count in sorted(results, key=lambda wc: wc[1], reverse=True)[:20]:

@@ -12,7 +12,8 @@ import sys
 from dampr_tpu import Dampr, setup_logging
 
 
-def main(fname):
+def build(fname):
+    """The four result handles over one shared tokenize+count prefix."""
     # Shared root: tokenized words, counted once.
     words = Dampr.text(fname, 1024 ** 2).flat_map(lambda line: line.split())
 
@@ -37,6 +38,19 @@ def main(fname):
                         .join(total_count)
                         .reduce(lambda awl, tc:
                                 next(awl)[1] / float(next(tc)[1])))
+
+    return total_count, top_words, word_lengths, avg_word_lengths
+
+
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook (docs/analysis.md)."""
+    tc, tw, wl, awl = build(__file__)
+    return [("total_count", tc), ("top_words", tw),
+            ("word_lengths", wl), ("avg_word_lengths", awl)]
+
+
+def main(fname):
+    total_count, top_words, word_lengths, avg_word_lengths = build(fname)
 
     tc, tw, wl, awl = Dampr.run(total_count, top_words, word_lengths,
                                 avg_word_lengths, name="word-stats")

@@ -1,0 +1,821 @@
+"""Static pipeline analysis suite (ISSUE 13): the adversarial UDF
+corpus, the pre-flight validator's coded diagnostics, the lint CLI, and
+the engine wiring the verdicts drive.
+
+Four pins matter most:
+
+- every adversarial UDF class — impure, nondeterministic, unpicklable,
+  non-associative, traceable-numeric — fires its diagnostic with the
+  correct evidence, and the shipped examples/benchmarks lint with ZERO
+  errors/warnings (the false-positive gate);
+- a certified numeric non-text chain executes on the device path with
+  the verdict visible in ``explain()``, byte-identical to the
+  per-record path (ROADMAP 5a);
+- speculation provably declines on a nondeterministic UDF (the
+  mitigation controller records why);
+- ``DAMPR_TPU_ANALYZE=0`` = byte-identical plans and results.
+"""
+
+import functools
+import json
+import operator
+import os
+import random
+import textwrap
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.analyze import (PreflightError, assoc, jaxtrace, lint,
+                               pickleprobe, props)
+from dampr_tpu.analyze import validate as av
+from dampr_tpu.plan import graph_signature, passes
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def analysis_on():
+    old = settings.analyze
+    settings.analyze = True
+    yield
+    settings.analyze = old
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# UDF property classifier (props)
+# ---------------------------------------------------------------------------
+
+_COUNTER = {"n": 0}
+
+
+def _impure_global(x):
+    global _G_SINK
+    _G_SINK = x
+    return x
+
+
+class TestClassifier:
+    def test_local_mutation_is_pure(self):
+        """The false-positive guard: building and mutating locals is
+        pure in every sense the engine cares about."""
+        def f(vals):
+            seen = set()
+            out = []
+            for v in vals:
+                if v not in seen:
+                    seen.add(v)
+                    out.append(v * 2)
+            out.sort()
+            return out
+
+        v = props.classify_callable(f)
+        assert v.pure and v.deterministic, v
+
+    def test_store_global_is_impure(self):
+        v = props.classify_callable(_impure_global)
+        assert not v.pure
+        assert any("global" in e for e in v.impure_evidence)
+        assert v.deterministic
+
+    def test_closure_mutator_method_named(self):
+        acc = []
+        f = lambda x: (acc.append(x), x)[1]  # noqa: E731
+        v = props.classify_callable(f)
+        assert not v.pure
+        assert any("'acc'" in e and "append" in e
+                   for e in v.impure_evidence), v.impure_evidence
+
+    def test_module_counter_update_is_impure(self):
+        def f(x):
+            _COUNTER["n"] += 1
+            return x
+
+        v = props.classify_callable(f)
+        assert not v.pure, v
+
+    def test_print_open_are_impure(self):
+        v = props.classify_callable(lambda x: print(x) or x)
+        assert not v.pure and any("print" in e for e in v.impure_evidence)
+        v = props.classify_callable(lambda p: open(p).read())
+        assert not v.pure and any("open" in e for e in v.impure_evidence)
+
+    def test_os_side_effects_are_impure(self):
+        def f(p):
+            os.remove(p)
+            return p
+
+        v = props.classify_callable(f)
+        assert not v.pure
+        assert any("os.remove" in e for e in v.impure_evidence)
+
+    def test_attr_write_on_closure_object_impure(self):
+        class Box:
+            pass
+
+        box = Box()
+
+        def f(x):
+            box.last = x
+            return x
+
+        v = props.classify_callable(f)
+        assert not v.pure
+        assert any("'box'" in e for e in v.impure_evidence)
+
+    def test_subscript_write_into_closure_dict_impure(self):
+        cache = {}
+
+        def f(x):
+            cache[x] = x * 2
+            return cache[x]
+
+        v = props.classify_callable(f)
+        assert not v.pure
+        assert any("'cache'" in e for e in v.impure_evidence)
+
+    def test_nonlocal_value_into_local_container_is_pure(self):
+        """Regression: ``d[k] = G`` loads the VALUE before the
+        container — the receiver check must look at the container
+        position only, or a pure UDF assigning a global/closure value
+        into its own local dict flags as impure."""
+        def f(v):
+            d = {}
+            d["k"] = _COUNTER
+            return len(d) + v
+
+        cfg = {"scale": 3}
+
+        def g(v):
+            out = {}
+            out[v] = cfg
+            return len(out)
+
+        for fn in (f, g):
+            ver = props.classify_callable(fn)
+            assert ver.pure, ver.impure_evidence
+
+    def test_self_attr_write_is_exempt(self):
+        """Instance state on a method's ``self`` is the per-job-copied
+        BlockMapper lifecycle contract, not shared-state impurity."""
+        class M:
+            def step(self, x):
+                self.total = getattr(self, "total", 0) + x
+                return self.total
+
+        v = props.classify_callable(M.step)
+        assert v.pure, v.impure_evidence
+
+    @pytest.mark.parametrize("f,frag", [
+        (lambda x: x + random.random(), "random"),
+        (lambda x: x + time.time() * 0, "time.time"),
+        (lambda x: (x, uuid.uuid4().hex)[0], "uuid"),
+    ])
+    def test_nondet_module_reads(self, f, frag):
+        v = props.classify_callable(f)
+        assert not v.deterministic
+        assert any(frag in e for e in v.nondet_evidence), v.nondet_evidence
+
+    def test_datetime_now_nondet(self):
+        import datetime
+
+        def f(x):
+            return (x, datetime.datetime.now())
+
+        v = props.classify_callable(f)
+        assert not v.deterministic, v
+
+    def test_numpy_random_nondet(self):
+        def f(x):
+            return x + np.random.rand() * 0
+
+        v = props.classify_callable(f)
+        assert not v.deterministic
+        assert any("numpy.random" in e or "rand" in e
+                   for e in v.nondet_evidence)
+
+    def test_closure_rng_instance_nondet(self):
+        rng = random.Random()
+
+        def f(x):
+            return x + rng.random() * 0
+
+        v = props.classify_callable(f)
+        assert not v.deterministic
+        assert any("'rng'" in e for e in v.nondet_evidence)
+
+    def test_bound_rng_method_nondet(self):
+        v = props.classify_callable(random.Random(7).random)
+        assert not v.deterministic
+
+    def test_partial_and_method_unwrap(self):
+        acc = []
+        f = functools.partial(lambda scale, x: (acc.append(x), x * scale)[1],
+                              3)
+        v = props.classify_callable(f)
+        assert not v.pure
+
+    def test_builtins_are_benign(self):
+        for f in (len, str.lower, operator.add, abs):
+            v = props.classify_callable(f)
+            assert v.pure and v.deterministic, (f, v)
+
+    def test_verdict_cache_returns_fresh_clones(self):
+        f = lambda x: x + 1  # noqa: E731
+        a = props.classify_callable(f)
+        a.name = "renamed"
+        a.impure("poisoned")
+        b = props.classify_callable(f)
+        assert b.pure and b.name != "renamed"
+
+
+# ---------------------------------------------------------------------------
+# Associativity (assoc)
+# ---------------------------------------------------------------------------
+
+class TestAssoc:
+    def test_recognized_kind_is_yes(self):
+        out = assoc.classify_binop(operator.add)
+        assert out["assoc"] == "yes" and out["kind"] is not None
+
+    def test_subtraction_proven_non_associative(self):
+        out = assoc.classify_binop(lambda a, b: a - b)
+        assert out["assoc"] == "no"
+        assert "counterexample" in out["evidence"]
+
+    def test_opaque_addlike_is_probably(self):
+        out = assoc.classify_binop(lambda a, b: b + a)
+        assert out["assoc"] == "probably"
+
+    def test_usertyped_binop_is_unknown(self):
+        out = assoc.classify_binop(lambda a, b: a.merge(b))
+        assert out["assoc"] == "unknown"
+
+    def test_probe_is_deterministic(self):
+        f = lambda a, b: a - b  # noqa: E731
+        assert assoc.classify_binop(f) == assoc.classify_binop(f)
+
+    def test_impure_binop_is_never_executed(self):
+        """The probe EXECUTES the binop on synthetic operands; a binop
+        with detectable side effects must never run under a "static"
+        lint — verdict unknown, zero calls."""
+        calls = []
+        out = assoc.classify_binop(
+            lambda a, b: (calls.append((a, b)), a + b)[1])
+        assert out["assoc"] == "unknown"
+        assert "impure" in out["evidence"]
+        assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# Pickle probe (pickleprobe)
+# ---------------------------------------------------------------------------
+
+class TestPickleProbe:
+    def test_clean_closure_probes_empty(self):
+        k = 3
+        assert pickleprobe.probe_callable(lambda x: x * k) == []
+
+    def test_lock_closure_names_the_variable(self):
+        lock = threading.Lock()
+        probs = pickleprobe.probe_callable(lambda x: x if lock else x)
+        assert len(probs) == 1
+        assert "lock" in probs[0]["variable"]
+        assert "pickle" in probs[0]["error"].lower() \
+            or "TypeError" in probs[0]["error"]
+
+    def test_partial_kwarg_probed(self):
+        bad = functools.partial(lambda x, res=None: x,
+                                res=threading.Lock())
+        probs = pickleprobe.probe_callable(bad)
+        assert any("res" in p["variable"] for p in probs)
+
+    def test_callable_object_attribute_probed(self):
+        class Op:
+            def __init__(self):
+                self.handle = threading.Lock()
+
+            def __call__(self, x):
+                return x
+
+        probs = pickleprobe.probe_callable(Op())
+        assert any("handle" in p["variable"] for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# Jax-traceability probe (jaxtrace)
+# ---------------------------------------------------------------------------
+
+class TestJaxTrace:
+    def test_numeric_map_and_filter_certify(self):
+        ok, _ = jaxtrace.certify_callable(lambda x: x * 3 + 1, "map")
+        assert ok
+        ok, _ = jaxtrace.certify_callable(lambda x: x % 2 == 0, "filter")
+        assert ok
+
+    def test_data_dependent_branch_rejected(self):
+        ok, why = jaxtrace.certify_callable(
+            lambda x: x * 2 if x > 0 else -x, "map")
+        assert not ok and why
+
+    def test_tuple_and_str_outputs_rejected(self):
+        ok, _ = jaxtrace.certify_callable(lambda x: (x, x), "map")
+        assert not ok
+        ok, _ = jaxtrace.certify_callable(lambda x: str(x), "map")
+        assert not ok
+
+    def test_chain_claims_requires_lane_vocabulary(self):
+        pipe = Dampr.memory(list(range(10))).flat_map(lambda x: [x, x])
+        stage = pipe.pmer.graph.stages[-1]
+        spec, why = jaxtrace.chain_claims(stage.mapper)
+        assert spec is None and "vocabulary" in why
+
+    def test_chain_claims_rejects_nondet_udf(self):
+        pipe = Dampr.memory(list(range(10))).map(
+            lambda x: x + random.random() * 0)
+        stage = pipe.pmer.graph.stages[-1]
+        spec, why = jaxtrace.chain_claims(stage.mapper)
+        assert spec is None and "nondeterministic" in why
+
+    def test_chain_program_exactness_with_filter_mask(self):
+        pipe = (Dampr.memory(list(range(64)))
+                .map(lambda x: x * 3 + 1)
+                .filter(lambda x: x % 2 == 0))
+        g, _ = passes.optimize(pipe.pmer.graph, [pipe.source])
+        stage = [s for s in g.stages if hasattr(s, "mapper")][-1]
+        prog = jaxtrace.stage_program(stage)
+        assert prog is not None
+        ks = list(range(64))
+        vs = list(range(64))
+        out = prog.run_batch(ks, vs)
+        exp = [(k, v * 3 + 1) for k, v in zip(ks, vs)
+               if (v * 3 + 1) % 2 == 0]
+        assert out is not None
+        assert list(zip(out[0], out[1])) == exp
+
+    def test_chain_program_nonnumeric_batch_falls_back(self):
+        pipe = Dampr.memory(list(range(8))).map(lambda x: x * 2)
+        g, _ = passes.optimize(pipe.pmer.graph, [pipe.source])
+        stage = [s for s in g.stages if hasattr(s, "mapper")][-1]
+        prog = jaxtrace.stage_program(stage)
+        assert prog is not None
+        assert prog.run_batch([0, 1], ["a", "b"]) is None
+        assert prog.counters["fallback"] >= 1
+
+    def test_zero_divide_batch_falls_back_not_inf(self):
+        """numpy turns 1.0/0.0 into inf where per-record Python raises
+        ZeroDivisionError; the vectorized host evaluation must fall the
+        batch back to the authoritative per-record path, never emit
+        the silent inf."""
+        pipe = Dampr.memory([1.0, 2.0]).map(lambda v: 1.0 / v)
+        g, _ = passes.optimize(pipe.pmer.graph, [pipe.source])
+        stage = [s for s in g.stages if hasattr(s, "mapper")][-1]
+        prog = jaxtrace.stage_program(stage)
+        assert prog is not None
+        ks = [0, 1, 2]
+        assert prog.run_batch(ks, [4.0, 2.0, 0.0]) is None
+        assert prog.counters["fallback"] >= 1
+        out = prog.run_batch(ks, [4.0, 2.0, 1.0])
+        assert out == (ks, [0.25, 0.5, 1.0])
+
+
+# ---------------------------------------------------------------------------
+# Pre-flight validator: the adversarial corpus end-to-end (PBase.validate)
+# ---------------------------------------------------------------------------
+
+class TestValidator:
+    def test_non_associative_fold_is_an_error(self):
+        pipe = Dampr.memory(list(range(50))).fold_by(
+            lambda x: x % 3, lambda a, b: a - b)
+        diags = pipe.validate()
+        errs = [d for d in diags if d.code == "DTA101"]
+        assert len(errs) == 1, _codes(diags)
+        assert errs[0].severity == "error"
+        assert any("counterexample" in e for e in errs[0].evidence)
+        # errors sort first
+        assert diags[0].code == "DTA101"
+
+    def test_assume_associative_suppresses(self):
+        pipe = Dampr.memory(list(range(50))).fold_by(
+            lambda x: x % 3, lambda a, b: a - b, assume_associative=True)
+        assert "DTA101" not in _codes(pipe.validate())
+
+    def test_impure_udf_warns_with_evidence(self):
+        acc = []
+        pipe = Dampr.memory(list(range(50))).map(
+            lambda x: (acc.append(x), x)[1])
+        diags = [d for d in pipe.validate() if d.code == "DTA201"]
+        assert len(diags) == 1
+        assert any("'acc'" in e for e in diags[0].evidence)
+
+    def test_nondet_udf_warns(self):
+        pipe = Dampr.memory(list(range(50))).map(
+            lambda x: x + random.random() * 0)
+        diags = [d for d in pipe.validate() if d.code == "DTA301"]
+        assert len(diags) == 1
+        assert any("random" in e for e in diags[0].evidence)
+
+    def _lock_pipe(self):
+        lock = threading.Lock()
+        return Dampr.memory(list(range(50))).map(
+            lambda x: x if lock else x)
+
+    def test_unpicklable_closure_warns_naming_variable(self):
+        diags = [d for d in self._lock_pipe().validate()
+                 if d.code == "DTA401"]
+        assert len(diags) == 1 and diags[0].severity == "warn"
+        assert any("'lock'" in e for e in diags[0].evidence)
+
+    def test_multiprocess_promotes_unpicklable_to_error(self):
+        diags = [d for d in self._lock_pipe().validate(num_processes=2)
+                 if d.code == "DTA401"]
+        assert diags and diags[0].severity == "error"
+
+    def test_resume_flags_volatile_fingerprint(self):
+        diags = self._lock_pipe().validate(resume=True)
+        assert "DTA402" in _codes(diags)
+
+    def test_probe_false_skips_serialization(self):
+        """``validate(probe=False)`` promises the fast bytecode-only
+        classification: the pickle probe must not serialize a single
+        captured byte (a closure-held broadcast table can be huge)."""
+        attempts = []
+
+        class Tattler(object):
+            def __reduce__(self):
+                attempts.append(1)
+                raise TypeError("unpicklable sentinel")
+
+        big = Tattler()
+        pipe = Dampr.memory(list(range(50))).map(
+            lambda x: x if big else x)
+        fast = pipe.validate(probe=False)
+        assert "DTA401" not in _codes(fast)
+        assert attempts == []
+        full = pipe.validate()
+        assert "DTA401" in _codes(full)
+        assert attempts
+
+    def test_traceable_chain_certified_info(self):
+        pipe = (Dampr.memory(list(range(50)))
+                .map(lambda x: x * 2)
+                .filter(lambda x: x > 5))
+        diags = [d for d in pipe.validate() if d.code == "DTA501"]
+        assert diags
+        assert any("certified" in e for d in diags for e in d.evidence)
+
+    def test_preflight_dispatch_check_names_everything(self):
+        pipe = self._lock_pipe()
+        with pytest.raises(PreflightError) as ei:
+            av.preflight_dispatch_check(pipe.pmer.graph, 2)
+        msg = str(ei.value)
+        assert "lock" in msg and "ValueMap" in msg and "DTA401" in msg
+
+    def test_preflight_noop_single_process_or_disabled(self):
+        pipe = self._lock_pipe()
+        av.preflight_dispatch_check(pipe.pmer.graph, 1)
+        settings.analyze = False
+        av.preflight_dispatch_check(pipe.pmer.graph, 2)
+
+    def test_assume_overrides_suppress_udf_diagnostics(self):
+        from dampr_tpu import base
+
+        acc = []
+
+        def f(x):
+            acc.append(x)
+            return x + random.random() * 0
+
+        pipe = Dampr.memory(list(range(20))).custom_mapper(
+            base.ValueMap(f), assume_pure=True,
+            assume_deterministic=True)
+        codes = _codes(pipe.validate())
+        assert "DTA201" not in codes and "DTA301" not in codes
+
+
+# ---------------------------------------------------------------------------
+# Zero false positives over everything we ship + the lint CLI
+# ---------------------------------------------------------------------------
+
+SHIPPED = [
+    os.path.join(ROOT, "examples", "wc.py"),
+    os.path.join(ROOT, "examples", "tf_idf.py"),
+    os.path.join(ROOT, "examples", "word_stats.py"),
+    os.path.join(ROOT, "examples", "sgd.py"),
+    os.path.join(ROOT, "dampr_tpu", "bench_tfidf.py"),
+    os.path.join(ROOT, "benchmarks", "sort_bench.py"),
+]
+
+
+class TestLint:
+    def test_shipped_pipelines_have_zero_false_positives(self):
+        """The acceptance gate: every example and benchmark pipeline
+        lints with 0 errors AND 0 warnings (info diagnostics — e.g. a
+        probabilistic associativity pass — are fine)."""
+        report = lint.run_lint(SHIPPED)
+        assert report["exit_code"] == 0, json.dumps(
+            report["diagnostics"], indent=2)
+        assert report["counts"]["error"] == 0
+        assert report["counts"]["warn"] == 0, report["diagnostics"]
+        for rec in report["targets"]:
+            assert rec["error"] is None and rec["pipelines"], rec
+
+    def test_report_is_schema_valid(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_lint", os.path.join(ROOT, "tools",
+                                          "validate_lint.py"))
+        vl = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vl)
+        with open(os.path.join(ROOT, "docs", "lint_schema.json")) as f:
+            schema = json.load(f)
+        report = lint.run_lint([SHIPPED[0]])
+        assert vl.validate(report, schema) == []
+        # and an erroring report stays schema-valid too
+        bad = lint.run_lint([os.path.join(ROOT, "does-not-exist.py")])
+        assert bad["exit_code"] == 2
+        assert vl.validate(bad, schema) == []
+
+    def _write_module(self, tmp_path, body):
+        p = tmp_path / "lintee.py"
+        p.write_text(textwrap.dedent(body))
+        return str(p)
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        bad = self._write_module(tmp_path, """
+            from dampr_tpu import Dampr
+
+            def lint_pipelines():
+                return [("bad", Dampr.memory(list(range(10))).fold_by(
+                    lambda x: x % 2, lambda a, b: a - b))]
+        """)
+        assert lint.main([bad]) == 1
+        out = capsys.readouterr().out
+        assert "DTA101" in out and "counterexample" in out
+        empty = self._write_module(tmp_path, "x = 1\n")
+        assert lint.main([empty]) == 2
+        clean = self._write_module(tmp_path, """
+            from dampr_tpu import Dampr
+
+            def lint_pipelines():
+                return [("ok", Dampr.memory(list(range(10)))
+                         .map(lambda x: x + 1))]
+        """)
+        assert lint.main([clean]) == 0
+        capsys.readouterr()
+
+    def test_strict_turns_warnings_into_failures(self, tmp_path, capsys):
+        warny = self._write_module(tmp_path, """
+            import random
+            from dampr_tpu import Dampr
+
+            def lint_pipelines():
+                return [("nd", Dampr.memory(list(range(10))).map(
+                    lambda x: x + random.random() * 0))]
+        """)
+        assert lint.main([warny]) == 0
+        assert lint.main(["--strict", warny]) == 1
+        capsys.readouterr()
+
+    def test_json_mode_emits_schema_report(self, tmp_path, capsys):
+        clean = self._write_module(tmp_path, """
+            from dampr_tpu import Dampr
+
+            def lint_pipelines():
+                return [("ok", Dampr.memory(list(range(10)))
+                         .map(lambda x: x + 1))]
+        """)
+        assert lint.main(["--json", clean]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["schema"] == lint.SCHEMA
+
+    def test_registry_discovery_without_hook(self, tmp_path, capsys):
+        """Modules without lint_pipelines(): live-handle discovery finds
+        the maximal constructed pipelines."""
+        mod = self._write_module(tmp_path, """
+            from dampr_tpu import Dampr
+
+            PIPE = (Dampr.memory(list(range(10)))
+                    .map(lambda x: x * 2)
+                    .filter(lambda x: x > 3))
+        """)
+        name, diags = lint.lint_target(mod)
+        assert name["pipelines"], name
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: fusion, lowering, speculation, and the off-switch
+# ---------------------------------------------------------------------------
+
+class TestEngineWiring:
+    def test_fusion_declines_across_impure_udf(self):
+        acc = []
+
+        def build():
+            return (Dampr.memory(list(range(100)))
+                    .map(lambda x: (acc.append(x), x)[1])
+                    .map(lambda x: x + 1))
+
+        pipe = build()
+        g_on, r_on = passes.optimize(pipe.pmer.graph, [pipe.source])
+        settings.analyze = False
+        g_off, r_off = passes.optimize(pipe.pmer.graph, [pipe.source])
+        settings.analyze = True
+        assert r_off["rules"]["fuse_maps"] > r_on["rules"]["fuse_maps"]
+        # pure chains still fuse with analysis on
+        pure = (Dampr.memory(list(range(100)))
+                .map(lambda x: x * 2).map(lambda x: x + 1))
+        _, rp = passes.optimize(pure.pmer.graph, [pure.source])
+        assert rp["rules"]["fuse_maps"] == 1
+
+    def test_analysis_off_plans_identical_for_pure_pipelines(self):
+        pipe = (Dampr.memory(list(range(100)))
+                .map(lambda x: x * 2).map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, operator.add))
+        g_on, _ = passes.optimize(pipe.pmer.graph, [pipe.source])
+        settings.analyze = False
+        g_off, _ = passes.optimize(pipe.pmer.graph, [pipe.source])
+        settings.analyze = True
+        assert graph_signature(g_on) == graph_signature(g_off)
+
+    def test_analysis_off_results_byte_identical(self, tmp_path):
+        """Even around an impure UDF (where the fusion decision
+        differs), results are byte-identical with analysis on vs off —
+        and fingerprints never move (analysis rides no stage
+        options)."""
+        from dampr_tpu import resume as _resume
+
+        def build():
+            acc = []
+            return (Dampr.memory([(i % 7, i) for i in range(2000)],
+                                 partitions=4)
+                    .map(lambda kv: (kv[0], kv[1] * 2))
+                    .map(lambda kv: (kv[0], kv[1] + 1))
+                    .fold_by(lambda kv: kv[0], operator.add,
+                             value=lambda kv: kv[1]))
+
+        pipe = build()
+        fps_on = _resume.stage_fingerprints(pipe.pmer.graph)
+        em = pipe.run(name="analyze-on")
+        on = sorted(em.read())
+        sec = em.stats()["plan"]["analysis"]
+        assert sec["enabled"] and sec["stages"]
+        em.delete()
+        settings.analyze = False
+        pipe2 = build()
+        fps_off = _resume.stage_fingerprints(pipe2.pmer.graph)
+        em = pipe2.run(name="analyze-off")
+        off = sorted(em.read())
+        sec_off = em.stats()["plan"]["analysis"]
+        assert not sec_off["enabled"] and not sec_off["stages"]
+        em.delete()
+        settings.analyze = True
+        assert on == off
+        assert list(fps_on.values()) == list(fps_off.values())
+
+    def test_certified_chain_runs_device_path_exactly(self):
+        """The ROADMAP-5a acceptance pin: a numeric non-text chain is
+        statically certified, lowers to the device target, dispatches
+        through the lane program with per-batch verification, and reads
+        back byte-identical to the per-record path — verdict visible in
+        explain()."""
+        old = (settings.lower, settings.device_min_batch)
+        settings.lower = "1"
+        settings.device_min_batch = 4096
+        try:
+            N = 20000
+
+            def build():
+                return (Dampr.memory(list(range(N)), partitions=2)
+                        .map(lambda x: x * 3 + 1)
+                        .filter(lambda x: x % 2 == 0))
+
+            pipe = build()
+            text = pipe.explain()
+            assert "certified jax-traceable" in text
+            assert "DTA501" in text
+            em = pipe.run(name="lane-dev")
+            got = sorted(em.read())
+            st = em.stats()
+            assert st["device"]["device_stages"] >= 1
+            targets = [s["target"] for s in st["stages"]
+                       if s["kind"] == "map"]
+            assert "device" in targets
+            em.delete()
+            prog = jaxtrace.stage_program(
+                [s for s in passes.optimize(
+                    pipe.pmer.graph, [pipe.source])[0].stages
+                 if hasattr(s, "mapper")][-1])
+            assert prog.counters["device_dispatched"] >= 1
+            assert prog.counters["device_mismatch"] == 0
+            assert prog.counters["diff_checked"] >= 1
+            assert prog.counters["diff_diverged"] == 0
+            settings.lower = "0"
+            settings.analyze = False
+            em = build().run(name="lane-host")
+            host = sorted(em.read())
+            em.delete()
+            assert got == host
+            assert got == sorted(v for v in (x * 3 + 1 for x in range(N))
+                                 if v % 2 == 0)
+        finally:
+            settings.lower, settings.device_min_batch = old
+            settings.analyze = True
+
+    def test_stale_device_annotation_cannot_dispatch_opaque_op(self):
+        """The runner re-certifies: an exec_target=device annotation on
+        a stage whose chain does not certify takes the per-record path
+        (stage_program returns None)."""
+        pipe = Dampr.memory(list(range(10))).flat_map(lambda x: [x, x])
+        stage = pipe.pmer.graph.stages[-1]
+        stage.options["exec_target"] = "device"
+        assert jaxtrace.stage_program(stage) is None
+        em = pipe.run(name="stale-annot")
+        assert sorted(em.read()) == sorted(
+            [x for x in range(10) for _ in (0, 1)])
+        em.delete()
+
+    def test_speculation_declines_on_nondet_udf(self, tmp_path):
+        """The acceptance pin: with mitigation armed and a straggling
+        map job, the analyzer's nondeterminism verdict vetoes
+        first-result-wins — zero speculative attempts, and the
+        controller records the decline with evidence."""
+        saved = (settings.scratch_root, settings.mitigate,
+                 settings.speculate_threshold, settings.faults,
+                 settings.max_processes)
+        settings.scratch_root = str(tmp_path)
+        settings.max_processes = 4
+        settings.mitigate = "on"
+        settings.speculate_threshold = 1.5
+        # exactly the first udf-batch invocation stalls: the straggler
+        # job the controller would speculate on
+        settings.faults = "udf:nth=1,sleep_ms=1200"
+        try:
+            data = [(i % 16, i) for i in range(8000)]
+            pipe = (Dampr.memory(data, partitions=4)
+                    .map(lambda x: (x[0], x[1] + int(time.time() * 0)))
+                    .fold_by(lambda x: x[0], operator.add,
+                             value=lambda x: x[1]))
+            em = pipe.run(name="spec-decline")
+            got = sorted(em.read())
+            mit = em.stats()["mitigation"]
+            em.delete()
+            assert mit["speculative_attempts"] == 0, mit
+            assert mit["speculation_declined"], mit
+            assert any("time" in e
+                       for rec in mit["speculation_declined"]
+                       for e in rec["evidence"])
+            exp = {}
+            for k, v in data:
+                exp[k] = exp.get(k, 0) + v
+            assert got == sorted(exp.items())
+        finally:
+            (settings.scratch_root, settings.mitigate,
+             settings.speculate_threshold, settings.faults,
+             settings.max_processes) = saved
+
+    def test_zero_divide_raises_like_analyze_off(self):
+        """Engine-level byte-identity pin for the errstate contract: a
+        certified chain hitting a zero divisor PAST the first
+        diff-tested batch raises the genuine ZeroDivisionError exactly
+        as an analyze-off run does — never a silent inf."""
+        old = (settings.lower, settings.device_min_batch)
+        settings.lower = "1"
+        settings.device_min_batch = 1 << 30  # host-vectorized only
+        data = [float(i) for i in range(1, 20000)] + [0.0]
+
+        def build():
+            return (Dampr.memory(data, partitions=2)
+                    .map(lambda v: 1.0 / v))
+
+        try:
+            with pytest.raises(ZeroDivisionError):
+                build().run(name="zero-div-on")
+            settings.lower = "0"
+            settings.analyze = False
+            with pytest.raises(ZeroDivisionError):
+                build().run(name="zero-div-off")
+        finally:
+            settings.lower, settings.device_min_batch = old
+            settings.analyze = True
+
+    def test_explain_renders_analysis_section(self):
+        acc = []
+        pipe = (Dampr.memory(list(range(30)))
+                .map(lambda x: (acc.append(x), x)[1]))
+        text = pipe.explain()
+        assert "analysis:" in text
+        assert "DTA201" in text and "'acc'" in text
+        settings.analyze = False
+        assert "analysis: off" in pipe.explain()
+        settings.analyze = True

@@ -252,11 +252,14 @@ class TestPipelineEquivalence:
 
     def test_ineligible_udf_falls_back_with_reason(self, corpus):
         """An opaque per-record UDF after the scanner keeps the whole
-        fused stage on host — and the decision records why."""
+        fused stage on host — and the decision records why.  The UDF
+        branches on its value, so the widened jax-traceability
+        vocabulary (dampr_tpu.analyze.jaxtrace) rejects it too — the
+        abstract eval hits the data-dependent ``if``."""
         docs = Dampr.text(corpus, os.path.getsize(corpus))
         pipe = (docs.custom_mapper(
             DocFreq(mode="word", lower=True, pair_values=False))
-            .map(lambda c: c * 2)
+            .map(lambda c: c * 2 if c > 0 else -c)
             .fold_values(operator.add))
         em = pipe.run(name="lowertest-udf")
         got_dev = em.read()

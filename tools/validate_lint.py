@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Validate a ``dampr-tpu-lint --json`` report against
+docs/lint_schema.json.
+
+Dependency-free (CI and containers without jsonschema): reuses the
+JSON-Schema subset checker from tools/validate_trace.py — type,
+required, properties, items, enum, minItems — plus lint-specific
+semantic rules the schema prose defers here:
+
+- every diagnostic ``code`` matches the stable ``DTA\\d{3}`` taxonomy
+  (docs/analysis.md);
+- ``counts`` agrees with the diagnostics list per severity;
+- ``exit_code`` is consistent: 2 requires a failed/empty target, 1
+  requires an error (or, under ``strict``, a warning), 0 requires
+  neither.
+
+Usage::
+
+    python tools/validate_lint.py REPORT.json
+        [--schema docs/lint_schema.json]
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+_CODE_RX = re.compile(r"^DTA\d{3}$")
+
+
+def _load_trace_checker():
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace", os.path.join(_HERE, "validate_trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def validate(report, schema):
+    """Return a list of error strings (empty = valid)."""
+    vt = _load_trace_checker()
+    errors = []
+    vt._check(report, schema, "$", errors)
+
+    diags = report.get("diagnostics")
+    if isinstance(diags, list):
+        got = {"error": 0, "warn": 0, "info": 0}
+        for i, d in enumerate(diags):
+            if not isinstance(d, dict):
+                continue
+            code = d.get("code", "")
+            if not _CODE_RX.match(str(code)):
+                errors.append(
+                    "diagnostics[{}]: code {!r} outside the DTA "
+                    "taxonomy".format(i, code))
+            sev = d.get("severity")
+            if sev in got:
+                got[sev] += 1
+        counts = report.get("counts")
+        if isinstance(counts, dict):
+            for sev, n in got.items():
+                if counts.get(sev) != n:
+                    errors.append(
+                        "counts.{}: {} != {} diagnostics of that "
+                        "severity".format(sev, counts.get(sev), n))
+
+    code = report.get("exit_code")
+    targets = report.get("targets") or []
+    failed = any(not isinstance(t, dict) or t.get("error") is not None
+                 or not t.get("pipelines") for t in targets)
+    counts = report.get("counts") or {}
+    strict = bool(report.get("strict"))
+    if isinstance(code, int) and isinstance(counts, dict):
+        want = (2 if failed
+                else 1 if (counts.get("error") or
+                           (strict and counts.get("warn")))
+                else 0)
+        if code != want:
+            errors.append("exit_code: {} inconsistent with targets/"
+                          "counts (want {})".format(code, want))
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("report")
+    ap.add_argument("--schema",
+                    default=os.path.join(_HERE, os.pardir, "docs",
+                                         "lint_schema.json"))
+    args = ap.parse_args(argv)
+    with open(args.report) as f:
+        report = json.load(f)
+    with open(args.schema) as f:
+        schema = json.load(f)
+    errors = validate(report, schema)
+    if errors:
+        for e in errors:
+            print("INVALID:", e, file=sys.stderr)
+        return 1
+    print("lint report OK: {} target(s), {} diagnostic(s)".format(
+        len(report.get("targets", [])),
+        len(report.get("diagnostics", []))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
