@@ -1,0 +1,163 @@
+"""Cross-stage device-handoff microbenchmark: the lowered map->fold EDGE
+in isolation (docs/plan.md "Cross-stage device fusion").
+
+The pipeline is the smallest one that has the edge — a native DocFreq
+scanner map feeding a device-lowered associative sum fold — run twice
+under forced lowering (``DAMPR_TPU_LOWER=1`` semantics, in-process):
+
+- ``spill`` leg (``DAMPR_TPU_HANDOFF=off``): the lowered map's program
+  outputs drain to host, pickle, frame-encode, spill, re-read and h2d
+  back into the fold — the pre-handoff edge;
+- ``device`` leg (``DAMPR_TPU_HANDOFF=on``): program outputs stay
+  HBM-resident in the per-job vocabulary accumulator and the collective
+  fold consumes them in place (``ops/handoff.py``).
+
+Both legs must produce byte-identical doc-frequency counts (asserted
+against each other AND a host-side oracle); the JSON reports per-leg
+walls, throughput, d2h bytes on the edge, and the drain bytes the
+device leg never fetched — check_bench-comparable via
+``metric``/``value`` (the device-leg MB/s is the headline).
+
+    python benchmarks/device_bench.py [--mb 16] [--trials 2] [--json F]
+
+CI runs the tiny flavor and compares against the checked-in
+``DEVICE_r01.json`` trajectory point (warn-only, tools/check_bench.py).
+"""
+
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
+import argparse
+import json
+import operator
+import os
+import re
+import sys
+import time
+from collections import Counter
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def oracle(corpus):
+    """Host-side doc-frequency oracle (the bench_tfidf baseline shape:
+    per line, the set of lowercased ``[^\\w]+``-split tokens)."""
+    rx = re.compile(r"[^\w]+")
+    counts = Counter()
+    with open(corpus, encoding="utf-8") as f:
+        for line in f:
+            counts.update(set(t for t in rx.split(line.lower()) if t))
+    return dict(counts)
+
+
+def run_leg(corpus, handoff, name, trials):
+    """One edge leg: forced lowering, handoff per ``handoff``.  Returns
+    (best wall seconds, result dict, device stats of the best run)."""
+    from dampr_tpu import Dampr, settings
+    from dampr_tpu.ops.text import DocFreq
+
+    old_lower, old_handoff = settings.lower, settings.handoff
+    settings.lower = "1"
+    settings.handoff = handoff
+    try:
+        import multiprocessing
+
+        chunk = os.path.getsize(corpus) // multiprocessing.cpu_count() + 1
+        best, result, dev = None, None, None
+        for t in range(max(1, trials)):
+            docs = Dampr.text(corpus, chunk)
+            df = (docs.custom_mapper(
+                DocFreq(mode="word", lower=True, pair_values=False))
+                .fold_values(operator.add))
+            t0 = time.time()
+            em = df.run(name="{}-t{}".format(name, t))
+            wall = time.time() - t0
+            got = dict(em.read())
+            stats = em.stats()
+            em.delete()
+            if best is None or wall < best:
+                best, result, dev = wall, got, stats["device"]
+        return best, result, dev
+    finally:
+        settings.lower = old_lower
+        settings.handoff = old_handoff
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int,
+                    default=int(os.environ.get("DAMPR_BENCH_MB", "16")))
+    ap.add_argument("--trials", type=int, default=2)
+    ap.add_argument("--json", default=None,
+                    help="also write the JSON record to this path")
+    args = ap.parse_args(argv)
+
+    from dampr_tpu.bench_tfidf import BENCH_DIR, make_corpus
+    from dampr_tpu.parallel.mesh import maybe_init_distributed
+
+    maybe_init_distributed()
+    corpus = os.path.join(BENCH_DIR, "corpus_{}mb.txt".format(args.mb))
+    make_corpus(corpus, args.mb)
+    size_mb = os.path.getsize(corpus) / 1e6
+    log("corpus: {} ({:.1f} MB)".format(corpus, size_mb))
+
+    spill_wall, spill_got, spill_dev = run_leg(
+        corpus, "off", "device-bench-spill", args.trials)
+    log("spill leg:  {:.2f}s = {:.1f} MB/s  (d2h {:.1f} MB)".format(
+        spill_wall, size_mb / spill_wall,
+        spill_dev["d2h_bytes"] / 1e6))
+
+    dev_wall, dev_got, dev_dev = run_leg(
+        corpus, "on", "device-bench-handoff", args.trials)
+    log("device leg: {:.2f}s = {:.1f} MB/s  (d2h {:.1f} MB, "
+        "avoided {:.1f} MB, edges {})".format(
+            dev_wall, size_mb / dev_wall, dev_dev["d2h_bytes"] / 1e6,
+            dev_dev["d2h_avoided_bytes"] / 1e6,
+            dev_dev["handoff_edges"]))
+
+    # Exactness: both legs agree with each other and the host oracle.
+    assert spill_got == dev_got, (
+        "handoff leg diverged from the spill leg: {} vs {} keys".format(
+            len(dev_got), len(spill_got)))
+    want = oracle(corpus)
+    assert dev_got == want, (
+        "device leg diverged from the host oracle: {} vs {} keys".format(
+            len(dev_got), len(want)))
+    log("verified {} doc-frequency entries exact on both legs".format(
+        len(want)))
+
+    assert dev_dev["handoff_edges"] >= 1, dev_dev
+    assert dev_dev["d2h_avoided_bytes"] > 0, dev_dev
+
+    rec = {
+        "metric": "device_handoff_throughput",
+        "unit": "MB/s",
+        "corpus_mb": round(size_mb, 1),
+        "trials": args.trials,
+        "spill_wall_s": round(spill_wall, 3),
+        "spill_MBps": round(size_mb / spill_wall, 2),
+        "spill_d2h_bytes": spill_dev["d2h_bytes"],
+        "device_wall_s": round(dev_wall, 3),
+        "device_MBps": round(size_mb / dev_wall, 2),
+        "device_d2h_bytes": dev_dev["d2h_bytes"],
+        "d2h_avoided_bytes": dev_dev["d2h_avoided_bytes"],
+        "d2h_reduction": round(
+            1.0 - dev_dev["d2h_bytes"] / float(spill_dev["d2h_bytes"]), 4)
+        if spill_dev["d2h_bytes"] else None,
+        "handoff_edges": dev_dev["handoff_edges"],
+        "handoff_bytes": dev_dev["handoff_bytes"],
+        "handoff_degrades": dev_dev["handoff_degrades"],
+        "speedup_vs_spill": round(spill_wall / dev_wall, 3),
+        "value": round(size_mb / dev_wall, 2),
+    }
+    line = json.dumps(rec)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return rec
+
+
+if __name__ == "__main__":
+    main()

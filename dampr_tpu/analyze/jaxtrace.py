@@ -69,7 +69,8 @@ def _eval_ok(f, dtype, kind):
         out = jax.eval_shape(f, jax.ShapeDtypeStruct((8,), dtype))
     except Exception as e:  # noqa: BLE001 - any trace failure is the answer
         return "{}: {}".format(type(e).__name__, str(e)[:160])
-    if not hasattr(out, "shape") or tuple(out.shape) != (8,):
+    ok_shapes = (((8,), ()) if kind == "value" else ((8,),))
+    if not hasattr(out, "shape") or tuple(out.shape) not in ok_shapes:
         return "not elementwise: input (8,) -> output {!r}".format(
             getattr(out, "shape", type(out).__name__))
     odt = np.dtype(out.dtype)
@@ -86,7 +87,10 @@ def certify_callable(f, kind):
     """Is ``f`` jax-traceable as an elementwise lane ``kind`` ("map" /
     "filter")?  Returns ``(ok, why)``; cached per function object."""
     with _CERT_LOCK:
-        hit = _CERT_CACHE.get(f)
+        try:
+            hit = _CERT_CACHE.get(f)
+        except TypeError:
+            hit = None  # unweakrefable callable (e.g. __slots__)
         if hit is not None and kind in hit:
             return hit[kind], hit.get("why_" + kind, "")
     import numpy as _np
@@ -111,13 +115,18 @@ def certify_callable(f, kind):
 
 
 class ChainSpec(object):
-    """A certified chain: ordered ``(kind, f)`` lane ops."""
+    """A certified chain: ordered ``(kind, f)`` lane ops, plus an
+    optional trailing re-key — ``rekey`` is ``(key_f, value_f_or_None)``
+    when the chain ends in a certified ``Rekey`` (the re-key every
+    ``fold_by``/``count``/``a_group_by`` plants), so a numeric chain can
+    feed a keyed device fold without leaving the lane program."""
 
-    __slots__ = ("ops", "names")
+    __slots__ = ("ops", "names", "rekey")
 
-    def __init__(self, ops, names):
+    def __init__(self, ops, names, rekey=None):
         self.ops = ops
         self.names = names
+        self.rekey = rekey
 
     def describe(self):
         return " . ".join(self.names)
@@ -133,38 +142,70 @@ def chain_claims(mapper, classify=True):
     from ..plan import ir
     from . import props
 
+    def _gate(f, kind):
+        """Classify + certify one UDF; returns the reason or None."""
+        if classify:
+            v = props.classify_callable(f)
+            if not v.pure:
+                return "UDF {} impure: {}".format(
+                    props.callable_name(f), "; ".join(v.impure_evidence))
+            if not v.deterministic:
+                return "UDF {} nondeterministic: {}".format(
+                    props.callable_name(f), "; ".join(v.nondet_evidence))
+        ok, why = certify_callable(f, kind)
+        if not ok:
+            return "UDF {} not traceable: {}".format(
+                props.callable_name(f), why)
+        return None
+
     ops = []
     names = []
+    rekey = None
     for leaf in ir.flatten_mapper(mapper):
         if type(leaf) is base.Map and leaf.mapper is base._identity:
             continue
+        if rekey is not None:
+            return None, "op {} follows the re-key — only a TRAILING " \
+                "Rekey certifies (records leave the value lane there)" \
+                .format(type(leaf).__name__)
         if type(leaf) is base.ValueMap:
             kind = "map"
         elif type(leaf) is base.Filter:
             kind = "filter"
+        elif type(leaf) is base.Rekey:
+            # Trailing re-key (fold_by/count/a_group_by): the key fn —
+            # and the value fn when present — certify as elementwise
+            # numeric maps over the value lane, so (key_f(v),
+            # value_f(v)) records build from two lanes of the same
+            # program.
+            why = _gate(leaf.key_f, "map")
+            if why is not None:
+                return None, "re-key " + why
+            if leaf.value_f is not None:
+                # "value" admits scalar outputs too (count()'s constant
+                # ``lambda v: 1`` broadcasts over the lane).
+                why = _gate(leaf.value_f, "value")
+                if why is not None:
+                    return None, "re-key value " + why
+            rekey = (leaf.key_f, leaf.value_f)
+            names.append("Rekey[{}]".format(
+                props.callable_name(leaf.key_f)))
+            continue
         else:
             return None, "op {} outside the certified lane vocabulary " \
-                "(ValueMap/Filter)".format(type(leaf).__name__)
+                "(ValueMap/Filter + trailing Rekey)".format(
+                    type(leaf).__name__)
         f = leaf.f
-        if classify:
-            v = props.classify_callable(f)
-            if not v.pure:
-                return None, "UDF {} impure: {}".format(
-                    props.callable_name(f), "; ".join(v.impure_evidence))
-            if not v.deterministic:
-                return None, "UDF {} nondeterministic: {}".format(
-                    props.callable_name(f), "; ".join(v.nondet_evidence))
-        ok, why = certify_callable(f, kind)
-        if not ok:
-            return None, "UDF {} not traceable: {}".format(
-                props.callable_name(f), why)
+        why = _gate(f, kind)
+        if why is not None:
+            return None, why
         ops.append((kind, f))
         names.append("{}[{}]".format(type(leaf).__name__,
                                      props.callable_name(f)))
-    if not ops:
+    if not ops and rekey is None:
         return None, "identity chain (nothing to lower)"
-    return ChainSpec(ops, names), "certified jax-traceable numeric " \
-        "chain: " + " . ".join(names)
+    return ChainSpec(ops, names, rekey=rekey), \
+        "certified jax-traceable numeric chain: " + " . ".join(names)
 
 
 # ---------------------------------------------------------------------------
@@ -197,13 +238,15 @@ class ChainProgram(object):
 
     # -- host (authoritative) evaluation ------------------------------------
     def run_host(self, vals):
-        """Vectorized 64-bit evaluation: ``(out_vals, mask_or_None)``.
-        ``vals`` is a 1-D numeric numpy array."""
+        """Vectorized 64-bit evaluation: ``(keys_or_None, out_vals,
+        mask_or_None)``.  ``vals`` is a 1-D numeric numpy array; ``keys``
+        is the re-key lane when the chain ends in a certified Rekey."""
         if vals.dtype.kind == "i":
             cur = vals.astype(np.int64, copy=False)
         else:
             cur = vals.astype(np.float64, copy=False)
         mask = None
+        keys = None
         # divide/invalid RAISE: numpy would silently emit inf/nan where
         # the authoritative per-record Python path raises
         # ZeroDivisionError — the FloatingPointError lands in
@@ -220,7 +263,14 @@ class ChainProgram(object):
                     m = np.asarray(f(cur))
                     m = m if m.dtype == bool else (m != 0)
                     mask = m if mask is None else (mask & m)
-        return cur, mask
+            if self.spec.rekey is not None:
+                key_f, value_f = self.spec.rekey
+                keys = np.asarray(key_f(cur))
+                if value_f is not None:
+                    cur = np.asarray(value_f(cur))
+                    if cur.ndim == 0:  # constant value fn (count())
+                        cur = np.broadcast_to(cur, keys.shape).copy()
+        return keys, cur, mask
 
     # -- device dispatch -----------------------------------------------------
     def _jit_for(self, dtype):
@@ -230,6 +280,7 @@ class ChainProgram(object):
             import jax
 
             ops = self.spec.ops
+            rekey = self.spec.rekey
 
             def program(lane):
                 cur = lane
@@ -245,7 +296,14 @@ class ChainProgram(object):
 
                 if mask is None:
                     mask = jnp.ones(lane.shape, dtype=bool)
-                return cur, mask
+                keys = None
+                if rekey is not None:
+                    key_f, value_f = rekey
+                    keys = key_f(cur)
+                    if value_f is not None:
+                        cur = jnp.broadcast_to(jnp.asarray(value_f(cur)),
+                                               keys.shape)
+                return keys, cur, mask
 
             fn = jax.jit(program)
             with self._lock:
@@ -290,7 +348,7 @@ class ChainProgram(object):
             self.count("fallback")
             return None
         try:
-            host_vals, mask = self.run_host(vals)
+            host_keys, host_vals, mask = self.run_host(vals)
             host_vals = np.asarray(host_vals)
         except Exception:  # noqa: BLE001 - the UDF rejected the lane form
             self.count("fallback")
@@ -299,13 +357,20 @@ class ChainProgram(object):
                 or host_vals.dtype.hasobject:
             self.count("fallback")
             return None
+        if self.spec.rekey is not None and (
+                host_keys is None or host_keys.ndim != 1
+                or len(host_keys) != len(vals)
+                or host_keys.dtype.hasobject):
+            self.count("fallback")
+            return None
         self.count("batches")
         ddt = self._device_dtype(vals) if (
             settings.use_device and settings.use_device_for(len(vals))) \
             else None
         if ddt is not None:
             try:
-                self._dispatch_and_verify(vals, ddt, host_vals, mask)
+                self._dispatch_and_verify(vals, ddt, host_keys,
+                                          host_vals, mask)
             except Exception as e:  # noqa: BLE001 - host result stands
                 self.count("device_mismatch")
                 log.debug("device chain dispatch failed (%s); host "
@@ -313,13 +378,16 @@ class ChainProgram(object):
         else:
             self.count("host_vectorized")
         out_vals = host_vals.tolist()
+        out_ks = (host_keys.tolist() if host_keys is not None
+                  else list(ks))
         if mask is None:
-            return list(ks), out_vals
+            return out_ks, out_vals
         keep = mask.tolist()
-        return (list(itertools.compress(ks, keep)),
+        return (list(itertools.compress(out_ks, keep)),
                 list(itertools.compress(out_vals, keep)))
 
-    def _dispatch_and_verify(self, vals, ddt, host_vals, mask):
+    def _dispatch_and_verify(self, vals, ddt, host_keys, host_vals,
+                             mask):
         from ..obs import trace as _trace
         from ..ops import devtime
 
@@ -329,20 +397,26 @@ class ChainProgram(object):
         if n_pad != n:
             lane = np.pad(lane, (0, n_pad - n), mode="edge")
         fn = self._jit_for(ddt)
-        t0 = None
         with _trace.span("device", "numeric-chain", records=n):
             with devtime.track("device"):
-                out, omask = fn(lane)
+                okeys, out, omask = fn(lane)
                 out = np.asarray(out)[:n]
                 omask = np.asarray(omask)[:n]
+                if okeys is not None:
+                    okeys = np.asarray(okeys)[:n]
         self.count("device_dispatched")
         hmask = (np.ones(n, dtype=bool) if mask is None else mask)
-        if host_vals.dtype.kind == "i":
-            dev64 = out.astype(np.int64)
-        else:
-            dev64 = out.astype(np.float64)
-        if np.array_equal(omask, hmask) and np.array_equal(
-                dev64[hmask], host_vals[hmask]):
+
+        def _up(a, ref):
+            return a.astype(np.int64 if ref.dtype.kind == "i"
+                            else np.float64)
+
+        verified = (np.array_equal(omask, hmask) and np.array_equal(
+            _up(out, host_vals)[hmask], host_vals[hmask]))
+        if verified and host_keys is not None:
+            verified = okeys is not None and np.array_equal(
+                _up(okeys, host_keys)[hmask], host_keys[hmask])
+        if verified:
             self.count("device_verified")
         else:
             self.count("device_mismatch")
@@ -364,7 +438,17 @@ _PROG_LOCK = threading.Lock()
 
 
 def _chain_key(spec):
-    return tuple((kind, id(f)) for kind, f in spec.ops)
+    """Cache key for one certified chain.  The trailing re-key is part
+    of the program identity: two bare ``fold_by``/``count`` chains have
+    identical (empty) lane ops but different key/value functions — an
+    ops-only key would hand the second stage the first one's compiled
+    program."""
+    key = tuple((kind, id(f)) for kind, f in spec.ops)
+    if spec.rekey is not None:
+        key_f, value_f = spec.rekey
+        key += (("rekey", id(key_f),
+                 id(value_f) if value_f is not None else None),)
+    return key
 
 
 def stage_program(stage):

@@ -328,6 +328,17 @@ def main():
         "device_stages": summary.get("device", {}).get("device_stages"),
         "h2d_bytes": summary.get("device", {}).get("h2d_bytes"),
         "d2h_bytes": summary.get("device", {}).get("d2h_bytes"),
+        # Cross-stage device handoff (docs/plan.md "Cross-stage device
+        # fusion", winning warm run): edges the plan kept HBM-resident,
+        # device bytes registered without a host round-trip, and the
+        # drain bytes the table-mode programs never fetched (the CI
+        # trace-smoke gate reads these).
+        "handoff_edges": summary.get("device", {}).get("handoff_edges"),
+        "handoff_bytes": summary.get("device", {}).get("handoff_bytes"),
+        "d2h_avoided_bytes": summary.get("device", {}).get(
+            "d2h_avoided_bytes"),
+        "handoff_degrades": summary.get("device", {}).get(
+            "handoff_degrades"),
         # Codec-attributable NON-overlapped fraction of the wall: codec
         # seconds the fold actually waited on (the full codec bucket when
         # the overlap executor is off).  This is the number the overlap

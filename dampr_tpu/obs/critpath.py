@@ -61,6 +61,11 @@ _RESOURCE_BY_CAT = {
     "collective": "mesh",
     "exchange": "mesh",
     "hbm": "transfer",
+    # Handoff spans (table-probe dispatches, the finalize that registers
+    # HBM-resident refs) are device program time: the tier exists to
+    # REPLACE transfer work, so classifying it as transfer would report
+    # the cure as the disease.
+    "handoff": "device",
     "stall": "overlap-stall",
     "checkpoint": "checkpoint",
 }

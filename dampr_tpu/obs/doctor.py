@@ -113,6 +113,25 @@ _PLAYBOOK = {
          "device programs bound the run — larger batches amortize "
          "dispatch overhead per token"),
     ],
+    # Cross-stage device handoff declined (or degraded mid-run) while
+    # transfer/device work bounds the run: the knobs that fund / admit
+    # the HBM-resident edge (docs/plan.md "Cross-stage device fusion").
+    "handoff": [
+        ("handoff", "DAMPR_TPU_HANDOFF",
+         lambda cur: None,
+         "the tier's own switch: auto declines when the run's config "
+         "disables lowering or zeroes the HBM budget — on forces the "
+         "edge resident"),
+        ("hbm_budget", "DAMPR_TPU_HBM_BUDGET",
+         lambda cur: None,
+         "a funded HBM residency budget lets lowered producer outputs "
+         "stay device-resident into the consuming fold instead of "
+         "round-tripping through host spill"),
+        ("lower_min_records", "DAMPR_TPU_LOWER_MIN_RECORDS",
+         lambda cur: max(1, int(cur or 0) // 4),
+         "a lower placement floor lets more adjacent stages lower, "
+         "turning spill edges into device-handoff edges"),
+    ],
     "host-compute": [
         ("max_processes", "",
          lambda cur: None,
@@ -454,6 +473,51 @@ def diagnose(run):
                 "suggested": max(200, interval * 4),
                 "why": "a longer sampling cadence bounds sampler cost",
             }],
+        })
+
+    # -- declined device handoff while transfer/device bounds the run --------
+    # The plan saw a device->device edge but spilled it, or the runtime
+    # degraded the edge mid-stage; if transfer or device work then
+    # dominated, the handoff/HBM-budget knobs are the lever (ROADMAP 5b,
+    # docs/plan.md "Cross-stage device fusion").  Only ACTIONABLE
+    # declines count, by the edge's typed `kind`: "settings" (the
+    # handoff/budget knobs directly) and "no-device-consumer" (a lower
+    # placement floor can lower the consumer).  "object-lane" has no
+    # device tier to buy, and a "priced" decline is the cost model
+    # already choosing the faster path — suggesting knobs against its
+    # evidence would be noise.
+    verdicts = {f["bottleneck"] for f in findings}
+    verdicts.add(((section or {}).get("run") or {}).get("verdict"))
+    for s in (section or {}).get("stages") or ():
+        verdicts.add(s.get("verdict"))
+    dev = summary.get("device") or {}
+    declined = [
+        e for e in (((summary.get("plan") or {}).get("lowering") or {})
+                    .get("handoff") or ())
+        if e.get("handoff") == "spill"
+        and e.get("kind") in ("settings", "no-device-consumer")]
+    degrades = dev.get("handoff_degrades") or 0
+    if (verdicts & {"transfer", "device"}) and (declined or degrades):
+        rf = ((section or {}).get("run") or {}).get("fractions") or {}
+        frac = min(1.0, (rf.get("transfer") or 0.0)
+                   + (rf.get("device") or 0.0))
+        sec = (frac or 0.05) * wall
+        if declined:
+            ev = ("transfer/device work bounds the run and {} device "
+                  "handoff edge(s) were declined ({})".format(
+                      len(declined), declined[0].get("reason")))
+        else:
+            ev = ("transfer/device work bounds the run and the device "
+                  "handoff degraded to the spill path {} time(s) "
+                  "mid-run".format(degrades))
+        findings.append({
+            "stage": None,
+            "bottleneck": "handoff",
+            "impact_seconds": round(sec, 4),
+            "severity": _severity(sec, wall),
+            "evidence": ev,
+            "suggestions": _suggestions_for("handoff", summary,
+                                            run_settings=run_settings),
         })
 
     # -- fleet verdicts (multi-process runs with a merged timeline) ----------

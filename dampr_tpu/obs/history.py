@@ -96,7 +96,7 @@ _KNOBS = ("analyze", "partitions", "batch_size", "max_memory_per_stage",
           "retry_backoff_ms", "max_quarantined", "exchange_timeout_ms",
           "mitigate", "speculate_threshold", "speculate_after_steps",
           "mitigate_probe_windows", "exchange_coding", "cost_model",
-          "autotune", "autotune_trials")
+          "autotune", "autotune_trials", "handoff")
 
 
 def corpus_path(run_name):
@@ -150,6 +150,18 @@ def compact_record(summary):
         },
         "device_fraction": (summary.get("device") or {}).get(
             "device_fraction"),
+        # Cross-stage handoff evidence (plan/model.price_handoff learns
+        # handoff-vs-spill seconds from these across runs).
+        "handoff": {
+            "edges": (summary.get("device") or {}).get(
+                "handoff_edges", 0),
+            "bytes": (summary.get("device") or {}).get(
+                "handoff_bytes", 0),
+            "d2h_avoided_bytes": (summary.get("device") or {}).get(
+                "d2h_avoided_bytes", 0),
+            "degrades": (summary.get("device") or {}).get(
+                "handoff_degrades", 0),
+        },
         "io_wait_fraction": (summary.get("io") or {}).get(
             "io_wait_fraction"),
         "settings": _settings_snapshot(),

@@ -189,6 +189,12 @@ def _lower_interpret():
     return jax.default_backend() not in ("tpu",)
 
 
+def _handoff_enter_frac():
+    from .handoff import _TABLE_ENTER_NEW_FRAC
+
+    return _TABLE_ENTER_NEW_FRAC
+
+
 class _Batch(object):
     """One dispatched program invocation plus the host metadata needed to
     drain it: the window-local token starts/lens the reps decode from."""
@@ -229,9 +235,19 @@ class DeviceTokenFoldSink(object):
     (drop-in for the scanners' ``window_sink()``).  ``add(win)`` feeds the
     window through double-buffered program dispatches and yields resolved
     partial-count Blocks; per-batch collision fallbacks and whole-window
-    host fallbacks keep results byte-identical to the host scanner."""
+    host fallbacks keep results byte-identical to the host scanner.
 
-    def __init__(self, params, store=None):
+    ``handoff=True`` (the plan's ``handoff="device"`` edge,
+    :mod:`.handoff`): emitted partials stay DEVICE-RESIDENT in a per-job
+    vocabulary accumulator instead of draining to host blocks — classic
+    batches bootstrap the vocabulary, later batches run the cheap
+    table-probe program, and ``finalize_handoff`` registers the
+    accumulated counts as HBM-resident BlockRefs the consuming fold
+    reads in place.  Any degrade flushes the accumulator into one
+    hash-sorted block and reverts to the classic emit path,
+    byte-identically."""
+
+    def __init__(self, params, store=None, handoff=False, jobs=1):
         self.mode = params["mode"]
         self.lower = params["lower"]
         self.dedup = params["dedup"]
@@ -239,6 +255,17 @@ class DeviceTokenFoldSink(object):
         self.store = store
         self.batches = 0
         self.fallbacks = 0
+        self._hv = None
+        if handoff and store is not None and not self.pair_values:
+            from . import handoff as _handoff
+
+            # Each concurrent job gets an equal share of the run's
+            # handoff budget: N parallel vocabularies can never hold
+            # N x budget of device memory between them.
+            share = (settings.effective_handoff_budget()
+                     // max(1, int(jobs)))
+            self._hv = _handoff.HandoffVocab(store, self.dedup,
+                                             budget=share)
 
     # -- host fallbacks ----------------------------------------------------
     def _host_window(self, win):
@@ -254,32 +281,16 @@ class DeviceTokenFoldSink(object):
         return (blk,) if blk is not None and len(blk) else ()
 
     def _host_batch(self, buf, starts, lens, lines):
-        """Exact host grouping for one collided batch: np.unique over
-        length-prefixed token byte rows — colliding hashes can never merge
-        distinct tokens.  MIRROR of text._numpy_counts_block's short-token
-        path (as _long_tokens mirrors its long path) parameterized on
-        precomputed bounds: a semantic change to either grouping MUST land
-        in both, or the equivalence suite's parity pins will catch it."""
+        """Exact host grouping for one collided batch:
+        ``handoff.group_token_rows`` (np.unique over length-prefixed
+        token byte rows — colliding hashes can never merge distinct
+        tokens; the ONE copy shared with the handoff miss path)."""
         from . import hashing
+        from .handoff import group_token_rows
 
         self.fallbacks += 1
-        n = len(starts)
-        L = int(lens.max())
-        idx = starts[:, None] + np.arange(L, dtype=np.int64)[None, :]
-        np.clip(idx, 0, len(buf) - 1, out=idx)
-        mat = np.where(np.arange(L, dtype=np.int32)[None, :]
-                       < lens[:, None], buf[idx], 0)
-        rows = np.empty((n, L + 1), dtype=np.uint8)
-        rows[:, 0] = lens
-        rows[:, 1:] = mat
-        uniq, inverse = np.unique(rows, axis=0, return_inverse=True)
-        inverse = inverse.reshape(-1)
-        if self.dedup:
-            combined = lines.astype(np.int64) * len(uniq) + inverse
-            uc = np.unique(combined)
-            counts = np.bincount(uc % len(uniq), minlength=len(uniq))
-        else:
-            counts = np.bincount(inverse, minlength=len(uniq))
+        uniq, counts = group_token_rows(buf, starts, lens, lines,
+                                        self.dedup)
         keys = np.empty(len(uniq), dtype=object)
         for i in range(len(uniq)):
             ln = int(uniq[i, 0])
@@ -328,19 +339,35 @@ class DeviceTokenFoldSink(object):
         return self._emit(keys, counts, h1, h2)
 
     # -- the pipeline ------------------------------------------------------
-    def _dispatch(self, buf, starts, lens, lines):
-        """Pad one batch to its shape bucket and launch the program; h2d
-        payload bytes are charged to the store's HBM counters.  Under
-        ``settings.profile`` the loop's sub-phases decompose: ``build``
-        (padded-matrix construction, host) and ``h2d`` (program dispatch
-        + argument feed) here, ``compute``/``d2h`` at drain."""
-        n = len(starts)
-        from .. import faults as _faults
+    @property
+    def _handoff_live(self):
+        return self._hv is not None and not self._hv.degraded
 
-        # Fault site: a classified failure here surfaces through the map
-        # job and rides the job retry loop (the whole-chunk fallback
-        # paths keep results byte-identical on re-execution).
-        _faults.check("device_dispatch")
+    def _absorb_or_out(self, blocks, out):
+        """Route host-path blocks: into the handoff accumulator while it
+        is live (a refused absorb degrades — the flushed accumulator and
+        the unabsorbed block both land in ``out``), else straight into
+        the emitted stream."""
+        for blk in blocks:
+            if blk is None or not len(blk):
+                continue
+            if self._handoff_live:
+                if self._hv.absorb_block(blk):
+                    continue
+                fb = self._hv.degrade("vocabulary or lane budget "
+                                      "exceeded")
+                if fb is not None and len(fb):
+                    out.append(fb)
+            out.append(blk)
+
+    def _degrade_to(self, out, reason):
+        fb = self._hv.degrade(reason)
+        if fb is not None and len(fb):
+            out.append(fb)
+
+    def _pad_batch(self, buf, starts, lens, lines):
+        """Shared padded-matrix construction for both program shapes."""
+        n = len(starts)
         prof = _profile.active()
         t0p = time.perf_counter() if prof is not None else 0.0
         with devtime.track("codec"):
@@ -360,6 +387,25 @@ class DeviceTokenFoldSink(object):
         if prof is not None:
             prof.device_add("build", time.perf_counter() - t0p,
                             mat.nbytes)
+        return mat, lens_p, lines_p
+
+    def _dispatch(self, buf, starts, lens, lines):
+        """Pad one batch to its shape bucket and launch the classic
+        program; h2d payload bytes are charged to the store's HBM
+        counters.  Under ``settings.profile`` the loop's sub-phases
+        decompose: ``build`` (padded-matrix construction, host) and
+        ``h2d`` (program dispatch + argument feed) here, ``compute``/
+        ``d2h`` at drain."""
+        n = len(starts)
+        from .. import faults as _faults
+
+        # Fault site: a classified failure here surfaces through the map
+        # job and rides the job retry loop (the whole-chunk fallback
+        # paths keep results byte-identical on re-execution).
+        _faults.check("device_dispatch")
+        prof = _profile.active()
+        mat, lens_p, lines_p = self._pad_batch(buf, starts, lens, lines)
+        npad, L = mat.shape
         fn = _token_fold_jit(npad, L, self.dedup,
                              settings.lower_pallas_segfold,
                              _lower_interpret())
@@ -377,9 +423,90 @@ class DeviceTokenFoldSink(object):
         self.batches += 1
         return _Batch(out, starts, lens, n)
 
-    def _drain(self, buf, batch):
-        """Fetch one program's results and build the partial-count Block
-        (vocabulary-sized).  Collisions re-group the batch on host."""
+    def _next_batch(self, buf, starts, lens, lines, out):
+        """Dispatch one batch through whichever program the vocabulary
+        state calls for (table probe once the vocabulary converged,
+        classic otherwise).  A refused table dispatch (overflow/budget
+        guard) degrades the job and falls back to classic."""
+        if self._handoff_live and self._hv.table_mode:
+            from .. import faults as _faults
+
+            _faults.check("device_dispatch")
+            mat, lens_p, lines_p = self._pad_batch(buf, starts, lens,
+                                                   lines)
+            prof = _profile.active()
+            t0p = time.perf_counter() if prof is not None else 0.0
+            batch = self._hv.dispatch(mat, lens_p, lines_p, starts, lens,
+                                      lines, len(starts))
+            if prof is not None:
+                prof.device_add("h2d", time.perf_counter() - t0p,
+                                mat.nbytes)
+            if batch is not None:
+                self.batches += 1
+                return batch
+            self._degrade_to(out, "count-lane overflow guard or hbm "
+                                  "budget exceeded mid-stage")
+        return self._dispatch(buf, starts, lens, lines)
+
+    def _resolve(self, buf, batch, out):
+        """Drain one in-flight dispatch of either shape into ``out`` (or
+        into the device accumulator when the handoff is live)."""
+        from .handoff import _TABLE_REVERT_MISS_FRAC, _TableBatch
+
+        if isinstance(batch, _TableBatch):
+            if not self._handoff_live:
+                # The vocabulary degraded while this dispatch was in
+                # flight: its HIT counts left with the accumulator
+                # flush (they scattered at dispatch time), but its
+                # misses never landed anywhere — emit them through the
+                # exact host grouping or they are lost.
+                self._emit_table_misses(buf, batch, out, count_d2h=True)
+                return
+            ok, miss_frac = self._hv.drain(buf, batch)
+            if not ok:
+                # The absorb refused (vocabulary/lane budget): no miss
+                # count landed, so the degrade flush holds only this
+                # batch's hits — the misses emit exactly on host.
+                self._degrade_to(out, "vocabulary or lane budget "
+                                      "exceeded")
+                self._emit_table_misses(buf, batch, out,
+                                        count_d2h=False)
+            elif miss_frac > _TABLE_REVERT_MISS_FRAC:
+                # Vocabulary shift: bootstrap again through the classic
+                # program until the table converges once more.
+                self._hv.table_mode = False
+            return
+        blk = self._drain(buf, batch, out)
+        if blk is not None and len(blk):
+            out.append(blk)
+
+    def _emit_table_misses(self, buf, batch, out, count_d2h):
+        """Missed tokens of a table dispatch that can no longer enter
+        the (degraded) accumulator: group them exactly on host —
+        ``_host_batch``, the same grouping the classic collision
+        fallback uses — and emit the block.  ``count_d2h`` charges the
+        miss-evidence fetch when :meth:`HandoffVocab.drain` has not
+        already done so."""
+        n_miss = int(batch.n_miss)
+        if count_d2h and self.store is not None:
+            self.store.count_d2h((batch.npad if n_miss else 0) + 4)
+        if not n_miss:
+            return
+        if batch.miss_idx is None:
+            miss = np.asarray(batch.miss)[:batch.n]
+            batch.miss_idx = np.flatnonzero(miss)
+        idx = batch.miss_idx
+        blk = self._host_batch(
+            buf, batch.starts[idx], batch.lens[idx],
+            batch.lines[idx] if batch.lines is not None else None)
+        if blk is not None and len(blk):
+            out.append(blk)
+
+    def _drain(self, buf, batch, out=None):
+        """Fetch one classic program's results and build the
+        partial-count Block (vocabulary-sized).  Collisions re-group the
+        batch on host.  With the handoff live, survivors seed the device
+        vocabulary instead of emitting (returns None)."""
         prof = _profile.active()
         with devtime.track("device"), _trace.span("device", "drain",
                                                   tokens=batch.n):
@@ -407,7 +534,11 @@ class DeviceTokenFoldSink(object):
                 # line ids were consumed by the program; rebuild them for
                 # the host regroup from the batch's token starts
                 lines = self._line_ids(buf, batch.starts)
-            return self._host_batch(buf, batch.starts, batch.lens, lines)
+            blk = self._host_batch(buf, batch.starts, batch.lens, lines)
+            if self._handoff_live and out is not None:
+                self._absorb_or_out((blk,), out)
+                return None
+            return blk
         idx = np.flatnonzero(live)
         if not len(idx):
             return None
@@ -421,6 +552,20 @@ class DeviceTokenFoldSink(object):
             s = int(starts[r])
             keys[i] = buf[s:s + int(lens[r])].tobytes().decode(
                 "utf-8", "replace")
+        if self._handoff_live:
+            ok, new_frac = self._hv.absorb_drain(keys, counts, h1g, h2g,
+                                                 batch.n)
+            if not ok:
+                if out is not None:
+                    self._degrade_to(out, "vocabulary or lane budget "
+                                          "exceeded")
+                    out.append(self._emit(keys, counts, h1g, h2g))
+                    return None
+                blk = self._emit(keys, counts, h1g, h2g)
+                return blk
+            if new_frac < _handoff_enter_frac():
+                self._hv.table_mode = True
+            return None
         return self._emit(keys, counts, h1g, h2g)
 
     def _line_ids(self, buf, starts):
@@ -434,6 +579,7 @@ class DeviceTokenFoldSink(object):
         buf = np.frombuffer(data, dtype=np.uint8)
         if not len(buf):
             return ()
+        out = []
         if (buf > 127).any():
             # Only valid-UTF-8 windows lower: token substrings of valid
             # UTF-8 decode losslessly (boundaries are ASCII), so no
@@ -444,7 +590,59 @@ class DeviceTokenFoldSink(object):
             try:
                 data.decode("utf-8")
             except UnicodeDecodeError:
-                return self._host_window(win)
+                self._absorb_or_out(self._host_window(win), out)
+                return out
+        if self._handoff_live and not self._hv.table_mode \
+                and not self._hv.nslots:
+            from .handoff import _host_bootstrap
+
+            if _host_bootstrap():
+                # CPU-backend bootstrap: the job's first window seeds the
+                # vocabulary through the NATIVE host codec — its blocks
+                # carry cached hash lanes, so the absorb never re-hashes
+                # or re-sorts, and this window's tokenize/pad/dispatch is
+                # skipped outright (~20x the classic bootstrap program,
+                # which has no accelerator to hide on here).  Counts are
+                # byte-identical: absorb_block keys by canonical utf-8
+                # bytes, the same contract as a classic drain.  Table
+                # mode engages immediately — a vocabulary that fails to
+                # cover the next window's batches reverts through the
+                # standard miss-fraction bar.
+                from .. import faults as _faults
+                from .handoff import CLASSIC_DRAIN_BYTES_PER_SLOT
+
+                # The bootstrap replaces this window's program dispatches
+                # — it keeps their fault site, so chaos schedules aimed
+                # at the lowered map fire on every backend.
+                _faults.check("device_dispatch")
+                with _trace.span("handoff", "bootstrap-host",
+                                 bytes=len(data)):
+                    # The native grouping is codec work — bucketed and
+                    # traced as such, so codec_fraction/critpath keep
+                    # attributing the scan's host compute when the
+                    # handoff swallows every emitted block.
+                    with devtime.track("codec"), _trace.span(
+                            "codec", "codec-window", bytes=len(data)):
+                        if self.dedup:
+                            blk = chunk_doc_freq(data, self.mode,
+                                                 self.lower,
+                                                 self.pair_values)
+                        else:
+                            blk = chunk_token_counts(data, self.mode,
+                                                     self.lower,
+                                                     self.pair_values)
+                    self._absorb_or_out(
+                        (blk,) if blk is not None else (), out)
+                if self._handoff_live and self._hv.nslots:
+                    self._hv.table_mode = True
+                    if self.store is not None and blk is not None:
+                        # Drain bytes the classic path would have
+                        # fetched for this window, one-batch lower
+                        # bound (its real fetch scales with padded
+                        # TOKENS, not distinct keys).
+                        self.store.count_d2h_avoided(
+                            CLASSIC_DRAIN_BYTES_PER_SLOT * len(blk))
+                return out
         with devtime.track("codec"):
             if self.lower:
                 buf = _LOWER[buf]
@@ -454,64 +652,80 @@ class DeviceTokenFoldSink(object):
             return ()
         line_id = self._line_ids(buf, starts) if self.dedup else None
 
-        out = []
         short = lens <= _SHORT_TOKEN
         long_idx = np.flatnonzero(~short)
+        s_starts, s_lens, s_lines = starts, lens, line_id
         if len(long_idx):
-            blk = self._long_tokens(buf, starts, lens, line_id, long_idx)
-            if blk is not None and len(blk):
-                out.append(blk)
             sidx = np.flatnonzero(short)
-            starts, lens = starts[sidx], lens[sidx]
-            line_id = line_id[sidx] if line_id is not None else None
-            n = len(starts)
-            if n == 0:
-                return out
+            s_starts, s_lens = starts[sidx], lens[sidx]
+            s_lines = line_id[sidx] if line_id is not None else None
+        ns = len(s_starts)
 
-        bounds = _batch_bounds(line_id, n, max(1024, settings.lower_batch))
+        bounds = (_batch_bounds(s_lines, ns,
+                                max(1024, settings.lower_batch))
+                  if ns else [])
         if bounds is None:
             # The whole-window host path recounts EVERY token, long ones
-            # included — any partials staged in `out` must be discarded or
-            # long tokens would count twice.
-            return tuple(self._host_window(win))
+            # included — nothing else may land for this window (long
+            # tokens commit only after this check passes, so they can
+            # never count twice).
+            self._absorb_or_out(self._host_window(win), out)
+            return out
+
+        if len(long_idx):
+            blk = self._long_tokens(buf, starts, lens, line_id, long_idx)
+            self._absorb_or_out((blk,), out)
+        if ns == 0:
+            return out
 
         # Double-buffered feed: build + dispatch batch i+1 while batch i's
         # program runs; drain resolves the previous dispatch only after
         # the next one is in flight (jax dispatch is async).
         pending = None
         for a, b in bounds:
-            nxt = self._dispatch(
-                buf, starts[a:b], lens[a:b],
-                line_id[a:b] if line_id is not None else None)
+            if (pending is not None and self._handoff_live
+                    and not self._hv.table_mode and not self._hv.nslots):
+                # Bootstrap sync: resolve the job's FIRST classic batch
+                # before the next dispatch — its drain seeds the
+                # vocabulary, so every remaining batch can run the cheap
+                # table program.  One batch of lost overlap buys
+                # table-mode for the rest of the job (jobs are only a
+                # handful of batches long).
+                self._resolve(buf, pending, out)
+                pending = None
+            nxt = self._next_batch(
+                buf, s_starts[a:b], s_lens[a:b],
+                s_lines[a:b] if s_lines is not None else None, out)
             if pending is not None:
-                blk = self._drain(buf, pending)
-                if blk is not None and len(blk):
-                    out.append(blk)
+                self._resolve(buf, pending, out)
             pending = nxt
         if pending is not None:
-            blk = self._drain(buf, pending)
-            if blk is not None and len(blk):
-                out.append(blk)
+            self._resolve(buf, pending, out)
         return out
 
     def finish(self):
         return ()
 
+    def finalize_handoff(self, store, n_partitions):
+        """Register the job's accumulated vocabulary as per-partition
+        HBM-resident refs (the plan's ``handoff="device"`` edge).
+        Returns ``(blocks, {pid: [BlockRef]})`` — ``blocks`` is the
+        degrade flush the caller must push through the classic combine
+        path; at most one side is non-empty."""
+        if self._hv is None:
+            return (), {}
+        return self._hv.finalize(store, n_partitions)
 
-def device_window_sink(mapper, store=None):
-    """The device window sink for a claimed mapper, or None."""
+
+def device_window_sink(mapper, store=None, handoff=False, jobs=1):
+    """The device window sink for a claimed mapper, or None.
+    ``handoff=True`` arms the cross-stage device-resident tier (a
+    pair-values scanner — an object lane with no device tier — silently
+    stays on the classic emit path); ``jobs`` is the stage's concurrent
+    job count, dividing the handoff budget per vocabulary."""
     params = claims(mapper)
     if params is None:
         return None
-    return DeviceTokenFoldSink(params, store=store)
+    return DeviceTokenFoldSink(params, store=store, handoff=handoff,
+                               jobs=jobs)
 
-
-def device_map_blocks(mapper, dataset, store=None):
-    """Lowered replacement for ``mapper.map_blocks``: drive the device
-    sink over the chunk's line-aligned windows (the SAME window driver as
-    the host scanners, so window boundaries — and therefore per-line
-    dedup scopes — are identical)."""
-    from .text import _drive_windows
-
-    return _drive_windows(mapper, dataset,
-                          sink=device_window_sink(mapper, store))

@@ -281,8 +281,8 @@ def _drive_windows(mapper, dataset, sink=None):
     chunk's line-aligned windows.  The runner's scan-sharing group executor
     drives several sinks over ONE window pass instead (runner.py
     run_map_group), so fused co-source stages read the tap once.
-    ``sink`` overrides the mapper's own sink (the device-lowered scan,
-    ops.lower.device_map_blocks)."""
+    ``sink`` overrides the mapper's own sink (the device-lowered scan:
+    the runner passes ops.lower.device_window_sink's sink here)."""
     if sink is None:
         sink = mapper.window_sink()
     for win in _scan_windows(dataset):

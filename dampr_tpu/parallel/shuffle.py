@@ -381,36 +381,6 @@ def _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind, nonneg,
         capacity *= 2
 
 
-def mesh_keyed_fold_dev(mesh, h1, h2, v, kind, nonneg=False,
-                        capacity_factor=None):
-    """Device-resident window fold: like ``mesh_keyed_fold(raw=True)`` but
-    the inputs are ALREADY jax arrays (the HBM storage tier's block lanes),
-    so padding happens with jnp and no host copy occurs in either
-    direction.  Lane safety is the CALLER's contract (the storage tier
-    verified the value lane at registration, where the host array still
-    existed); ``nonneg`` likewise comes from registration-time metadata.
-    Returns the padded ``(h1, h2, v, ok)`` partials, device-resident."""
-    import jax.numpy as jnp
-
-    n_dev = mesh_size(mesh)
-    total = h1.shape[0]
-    if total == 0:
-        z = jnp.zeros(0, jnp.uint32)
-        return z, z, v[:0], z
-    n_local = _pad_pow2(-(-total // n_dev))
-    padded = n_local * n_dev
-    valid = jnp.ones(total, dtype=jnp.uint32)
-    if padded != total:
-        pad = padded - total
-        h1 = jnp.pad(h1, (0, pad))
-        h2 = jnp.pad(h2, (0, pad))
-        v = jnp.pad(v, (0, pad))
-        valid = jnp.pad(valid, (0, pad))
-    factor = capacity_factor or settings.shuffle_capacity_factor
-    return _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind,
-                            nonneg, factor)
-
-
 def mesh_keyed_refold(mesh, parts, kind, nonneg=False, capacity_factor=None):
     """Re-fold device-resident partials from ``mesh_keyed_fold(raw=True)``.
 
@@ -443,6 +413,51 @@ def mesh_keyed_refold(mesh, parts, kind, nonneg=False, capacity_factor=None):
     factor = capacity_factor or settings.shuffle_capacity_factor
     return _run_fold_padded(mesh, h1, h2, v, valid, n_dev, n_local, kind,
                             nonneg, factor)
+
+
+@functools.lru_cache(maxsize=None)
+def _live_prefix_sort(n):
+    """Stable sort moving live (ok == 1) entries to a prefix — the
+    device-side half of :func:`compact_partial`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def kernel(h1, h2, v, ok):
+        inv = jnp.where(ok == 1, jnp.uint32(0), jnp.uint32(1))
+        _, sh1, sh2, sv = lax.sort((inv, h1, h2, v), num_keys=1,
+                                   is_stable=True)
+        return sh1, sh2, sv
+
+    return jax.jit(kernel)
+
+
+def compact_partial(part):
+    """Shrink a device-resident ``(h1, h2, v, ok)`` partial to (a pow2
+    pad of) its LIVE entries.
+
+    Fold programs return capacity-padded lanes — ~1.5x their input,
+    dead rows included — so accumulating partials through repeated
+    ``mesh_keyed_refold`` rounds grows the padded garbage geometrically
+    even when the distinct-key count is tiny (each round re-feeds the
+    previous round's dead pad).  One validity sort + a prefix slice per
+    compaction round bounds every partial at the distinct-key count
+    instead.  Costs one scalar fetch (the live count); shapes stay pow2,
+    so compile buckets stay bounded."""
+    import jax.numpy as jnp
+
+    h1, h2, v, ok = part
+    n = int(h1.shape[0])
+    if n == 0:
+        return part
+    nlive = int(jnp.sum(jnp.where(ok == 1, 1, 0)))
+    m = _pad_pow2(max(1, nlive))
+    if m >= n:
+        return part
+    sh1, sh2, sv = _live_prefix_sort(n)(h1, h2, v, ok)
+    okc = (jnp.arange(m, dtype=jnp.int32)
+           < jnp.int32(nlive)).astype(jnp.uint32)
+    return sh1[:m], sh2[:m], sv[:m], okc
 
 
 def mesh_global_sum(mesh, v):

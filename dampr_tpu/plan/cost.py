@@ -128,6 +128,31 @@ def current_model(run_name, graph):
         return None
 
 
+def handoff_choice(run_name, graph):
+    """Corpus-priced handoff-vs-spill decision for this plan's
+    device->device edges (``plan.lower.handoff_analyze``, auto mode
+    only).  Returns (decision, reason): ``"device"``/``"spill"``, or
+    None when there is no evidence — auto then keeps the edge resident
+    and the recorded reason says what a measurement would add."""
+    if not settings.cost_model_enabled() or not run_name:
+        return None, ("no corpus pricing (cost model off or unnamed "
+                      "run) — auto keeps the edge resident")
+    try:
+        from ..obs import history
+        from . import model as _model
+
+        records = history.load(run_name)
+        if not records:
+            return None, ("empty corpus — no handoff-vs-spill evidence "
+                          "yet")
+        fp = history.plan_fingerprint(ir.stage_shapes(graph))
+        return _model.price_handoff(records, fp)
+    except Exception:
+        log.debug("handoff pricing unavailable for %r", run_name,
+                  exc_info=True)
+        return None, "corpus pricing unavailable"
+
+
 def load_tuned(run_name):
     """The persisted autotune winner for a run name
     (``<scratch_root>/<run>/tuned.json``, written by

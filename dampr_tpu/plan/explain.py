@@ -227,4 +227,17 @@ def _target_lines(graph, name, outputs=()):
     for d in decisions:
         lines.append("  s{}: {} -> {}  ({})".format(
             d["sid"], d["kind"], d["target"], d["reason"]))
+    # Cross-stage fusion: which device->device edges keep their lowered
+    # dataflow HBM-resident (plan.lower.handoff_analyze — the runner
+    # threads those producers' program outputs straight into the
+    # consuming collective fold).
+    edges = lower.handoff_analyze(graph, decisions, run_name=name)
+    if edges:
+        n_hand = sum(1 for e in edges if e["handoff"] == "device")
+        lines.append("handoff: {} of {} device edge(s) stay "
+                     "HBM-resident across the stage boundary".format(
+                         n_hand, len(edges)))
+        for e in edges:
+            lines.append("  s{} -> s{}: {}  ({})".format(
+                e["src"], e["dst"], e["handoff"], e["reason"]))
     return lines

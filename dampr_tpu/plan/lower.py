@@ -201,7 +201,87 @@ def analyze(graph, history=None, outputs=()):
 
 
 def empty_section(enabled):
-    return {"enabled": enabled, "targets": [], "device_stages": 0}
+    return {"enabled": enabled, "targets": [], "device_stages": 0,
+            "handoff": []}
+
+
+def handoff_analyze(graph, decisions, run_name=None):
+    """Cross-stage fusion pass: per producer->consumer edge, may the
+    producer's program outputs stay HBM-resident for the consumer
+    (``handoff="device"``) or must they spill through the host tier
+    (``handoff="spill"``)?  An edge qualifies when BOTH endpoints
+    lowered: the producer is a device map (native scanner or certified
+    lane chain) and the consumer is a device-lowered associative fold —
+    then the runner threads the producer's outputs as HBM-resident
+    BlockRefs straight into the collective fold, skipping d2h, pickle,
+    frame encode/decode, and h2d on that edge.  Every decline carries a
+    reason; results are byte-identical either way (runtime degrades fall
+    back to the spill path per batch or per edge)."""
+    from ..ops import lower as ops_lower
+
+    targets = {d["sid"]: d for d in decisions}
+    edges = []
+    if not any(d["target"] == "device" for d in decisions):
+        return edges
+    priced = None
+    for sid, stage in enumerate(graph.stages):
+        d = targets.get(sid)
+        if d is None or d["target"] != "device" or d["kind"] != "map":
+            continue
+        consumers = [(cid, c) for cid, c in enumerate(graph.stages)
+                     if stage.output in getattr(c, "inputs", ())]
+        for cid, cons in consumers:
+            cd = targets.get(cid)
+            edge = {"src": sid, "dst": cid}
+            if (not isinstance(cons, GReduce) or cd is None
+                    or cd["target"] != "device"):
+                edge["handoff"] = "spill"
+                edge["kind"] = "no-device-consumer"
+                edge["reason"] = ("consumer is not a device-lowered "
+                                  "fold — outputs drain through the "
+                                  "host tier")
+                edges.append(edge)
+                continue
+            if not settings.handoff_enabled():
+                edge["handoff"] = "spill"
+                edge["kind"] = "settings"
+                edge["reason"] = (
+                    "handoff off (settings.handoff={!r}; hbm budget {} "
+                    "on this backend)".format(
+                        settings.handoff, settings.effective_hbm_budget()))
+                edges.append(edge)
+                continue
+            params = ops_lower.claims(stage.mapper)
+            if params is not None and params.get("pair_values"):
+                edge["handoff"] = "spill"
+                edge["kind"] = "object-lane"
+                edge["reason"] = ("pair-values scanner emits an object "
+                                  "lane — no device tier for it")
+                edges.append(edge)
+                continue
+            if not settings.handoff_forced() and run_name:
+                if priced is None:
+                    from . import cost
+
+                    priced = cost.handoff_choice(run_name, graph)
+                choice, why = priced
+                if choice == "spill":
+                    edge["handoff"] = "spill"
+                    edge["kind"] = "priced"
+                    edge["reason"] = why
+                    edges.append(edge)
+                    continue
+            edge["handoff"] = "device"
+            edge["kind"] = "resident"
+            edge["via"] = ("scanner-program" if params is not None
+                           else "lane-program")
+            edge["reason"] = (
+                "producer program outputs stay HBM-resident into the "
+                "collective fold — d2h/spill/h2d skipped on this edge"
+                + ("" if priced is None or priced[0] is None
+                   else " ({})".format(priced[1])))
+            edges.append(edge)
+    return edges
 
 
 def empty_shuffle_section(enabled):
@@ -347,6 +427,7 @@ def apply(runner, outputs, report):
     graph = getattr(runner, "graph", None)
     report["lowering"] = empty_section(False)
     report["device_stages"] = 0
+    report["handoff_edges"] = 0
     if graph is None or not hasattr(graph, "stages"):
         return
     if not settings.lower_enabled():
@@ -374,10 +455,30 @@ def apply(runner, outputs, report):
     report["device_stages"] = len(lowered)
     if not lowered:
         return
+    # Cross-stage fusion: which device->device edges keep their dataflow
+    # HBM-resident (the handoff tier) instead of spilling through host.
+    edges = handoff_analyze(graph, decisions,
+                            run_name=getattr(runner, "name", None))
+    section["handoff"] = edges
+    dev_edges = [e for e in edges if e["handoff"] == "device"]
+    report["handoff_edges"] = len(dev_edges)
+    hand_sids = {e["src"] for e in dev_edges}
+    try:
+        runner._handoff_sids = hand_sids
+    except AttributeError:
+        pass
+    store = getattr(runner, "store", None)
+    if store is not None and hand_sids:
+        # Arms the store's handoff budget (on forced CPU-JAX legs the
+        # plain HBM budget resolves to 0 and would instantly evict the
+        # refs the handoff just kept resident).  Runs without handoff
+        # edges keep the classic budget untouched.
+        store.handoff_active = True
     stages = list(graph.stages)
     for sid in lowered:
         opts = dict(stages[sid].options or {})
         opts["exec_target"] = "device"
         stages[sid] = ir.clone_with_options(stages[sid], opts)
     runner.graph = ir.rebuilt(stages)
-    log.info("plan: %d stage(s) lowered to device programs", len(lowered))
+    log.info("plan: %d stage(s) lowered to device programs, %d "
+             "device-handoff edge(s)", len(lowered), len(dev_edges))

@@ -507,6 +507,73 @@ def predict_plan(model, hist_stages, n_partitions):
     return total
 
 
+def _run_secs_per_mb(rec):
+    """Wall seconds per input megabyte for one corpus record, or None.
+    Input volume is the largest stage ``bytes_in`` (the corpus scan) —
+    normalizing lets differently-sized runs of the same plan shape
+    price one edge."""
+    wall = rec.get("wall_seconds")
+    if not wall or wall <= 0:
+        return None
+    mb = max((float(st.get("bytes_in") or 0)
+              for st in rec.get("stages") or ()), default=0.0) / 1e6
+    if mb <= 0:
+        return None
+    return float(wall) / mb
+
+
+def price_handoff(records, fingerprint):
+    """Observed handoff-vs-spill pricing for one plan fingerprint: BEST
+    wall seconds PER INPUT MB of corpus runs whose plan carried
+    device-handoff edges vs LOWERED runs that spilled the same edges.
+    Only lowered runs qualify on either side — a host-codec run never
+    had the edge to decide, so its wall says nothing about handoff-vs-
+    spill — and only the most recent ``settings.history_window`` records
+    per side vote, so stale configurations age out.  The comparison is
+    each side's MINIMUM: recorded walls include one-time jit compiles
+    (every cold process re-pays them) and box-load noise, both of which
+    only ever inflate a wall, so the best observed run is the honest
+    steady-state estimate — a median would let one side's cold-compile
+    records outvote the other side's warm ones.  Returns (decision,
+    reason) — decision ``"device"``/``"spill"``, or None when the corpus
+    lacks variance (auto then defaults to the handoff; the reason is the
+    honest 'measure me' signal the autotune loop acts on)."""
+    on, off = [], []
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("rank"):
+            continue
+        if rec.get("fingerprint") != fingerprint:
+            continue
+        if not rec.get("device_fraction"):
+            continue  # host-path run: the edge never existed
+        spm = _run_secs_per_mb(rec)
+        if spm is None:
+            continue
+        h = rec.get("handoff") or {}
+        if h.get("edges") and not h.get("degrades"):
+            on.append(spm)
+        elif not h.get("edges"):
+            off.append(spm)
+        # degraded handoff runs vote on neither side: their wall mixes
+        # both paths
+    win = max(1, int(getattr(settings, "history_window", 8)))
+    on, off = on[-win:], off[-win:]
+    if not on or not off:
+        return None, ("no handoff-vs-spill variance among lowered runs "
+                      "({} with the edge resident, {} without)"
+                      .format(len(on), len(off)))
+    mon = min(on)
+    moff = min(off)
+    if mon > moff * (1.0 + max(0.0, settings.cost_model_margin)):
+        return "spill", ("corpus prices the spill path faster "
+                         "({:.3f} vs {:.3f} s/MB best-of over {}+{} "
+                         "lowered runs) — edge declined".format(
+                             moff, mon, len(off), len(on)))
+    return "device", ("corpus prices the resident edge faster "
+                      "({:.3f} vs {:.3f} s/MB best-of over {}+{} lowered "
+                      "runs)".format(mon, moff, len(on), len(off)))
+
+
 def shuffle_prediction(model, mb):
     """(target, reason) from modeled exchange-vs-fold throughput for one
     redistribution of ``mb`` megabytes, or None when either class is

@@ -52,6 +52,12 @@ log = logging.getLogger("dampr_tpu.runner")
 # (dampr.py:661-673) but in block units.
 _PARTIAL_FANIN = 8
 
+#: Device-partial compaction trigger for the mesh fold (lanes, not
+#: partial count): vocabulary-sized handoff partials accumulate until one
+#: deterministic refold, while capacity-sized window partials still
+#: compact before they stack past device memory.
+_REFOLD_LANE_CAP = 1 << 20
+
 
 def _clone_op(op):
     """Per-job operator instance.  The built-in stateless wrapper ops
@@ -798,6 +804,12 @@ class MTRunner(object):
         # plan layer (plan.lower.apply_shuffle) — a dispatch hint, not
         # stage options, so fingerprints never depend on history.
         self._shuffle_targets = {}
+        # Producer stage ids whose output edge the plan marked
+        # handoff="device" (cross-stage device-resident handoff): their
+        # jobs keep program outputs HBM-resident for the consuming fold.
+        # A dispatch decision like _shuffle_targets — never stage
+        # options, so resume/cache fingerprints stay history-independent.
+        self._handoff_sids = set()
         self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
         self.retries_total = 0  # transient-failure job re-executions
         self._retry_lock = threading.Lock()
@@ -1029,18 +1041,20 @@ class MTRunner(object):
 
         (job, combine_op, pin, feeds_reduce, _new_sink,
          feeds_dev, run_mode, _wsink) = self._map_job_factory(
-            stage, supplementary)
+            stage, supplementary, sid=stage_id)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
         results = self._pool_run(job, chunks, n_maps, label="map",
                                  speculative=self._speculation_ok(stage))
-        pset = self._collect_partitions(results, combine_op, pin,
-                                        feeds_reduce, device=feeds_dev,
-                                        sorted_runs=run_mode)
+        pset = self._collect_partitions(
+            results, combine_op, pin, feeds_reduce, device=feeds_dev,
+            sorted_runs=run_mode,
+            handoff=stage_id in self._handoff_sids)
         return pset, pset.total_records(), len(chunks)
 
     def _collect_partitions(self, mappings, combine_op, pin, feeds_reduce,
-                            device=False, sorted_runs=False):
+                            device=False, sorted_runs=False,
+                            handoff=False):
         """Assemble per-chunk {pid: [refs]} job results into one compacted
         PartitionSet (shared by run_map and run_map_group).
 
@@ -1069,7 +1083,7 @@ class MTRunner(object):
             self._plan_sorted_merge(pset)
         else:
             self._compact_partitions(pset, combine_op, pin, feeds_reduce,
-                                     device=device)
+                                     device=device, handoff=handoff)
         return pset
 
     def _effective_merge_fanin(self, runs):
@@ -1213,7 +1227,8 @@ class MTRunner(object):
         given order."""
         tap = env[stages[0].inputs[0]]
         chunks = self._as_chunks(tap)
-        factories = [self._map_job_factory(s, []) for s in stages]
+        factories = [self._map_job_factory(s, [], sid=sjd)
+                     for sjd, s in zip(sids, stages)]
         order = sorted(range(len(stages)),
                        key=lambda i: bool(
                            getattr(stages[i].mapper, "streams_bytes", False)))
@@ -1260,7 +1275,20 @@ class MTRunner(object):
                         gen, self.store,
                         size_of=lambda it: it[1].nbytes()):
                     members[mi][1](blk)
-                return [end() for _wsink, _push, end in members]
+                outs_w = []
+                for wsink, push_m, end_m in members:
+                    hmap = None
+                    if hasattr(wsink, "finalize_handoff"):
+                        fblocks, hmap = wsink.finalize_handoff(
+                            self.store, self.n_partitions)
+                        for blk in fblocks:
+                            push_m(blk)
+                    o = end_m()
+                    if hmap:
+                        for pid, refs in hmap.items():
+                            o.setdefault(pid, []).extend(refs)
+                    outs_w.append(o)
+                return outs_w
             shared = (_SharedScanChunk(chunk)
                       if hasattr(chunk, "read_bytes") else chunk)
             outs = [None] * len(stages)
@@ -1281,16 +1309,18 @@ class MTRunner(object):
              feeds_dev, run_mode, _wsink) = factories[i]
             pset = self._collect_partitions(
                 [outs[i] for outs in results], combine_op, pin, feeds_reduce,
-                device=feeds_dev, sorted_runs=run_mode)
+                device=feeds_dev, sorted_runs=run_mode,
+                handoff=sids[i] in self._handoff_sids)
             ret.append((pset, pset.total_records(), len(chunks)))
         log.info("scan sharing: %d stages fused over one pass of %d chunks",
                  len(stages), len(chunks))
         return ret
 
-    def _map_job_factory(self, stage, supplementary):
+    def _map_job_factory(self, stage, supplementary, sid=None):
         """Build the per-chunk job closure for one map stage.  Shared by
         run_map and the scan-sharing group executor (run_map_group), which
-        runs several stages' jobs over one chunk read."""
+        runs several stages' jobs over one chunk read.  ``sid`` keys the
+        plan's per-edge dispatch decisions (the device-handoff set)."""
         combine_op = None
         if isinstance(stage.combiner, base.PartialReduceCombiner):
             combine_op = stage.combiner.op
@@ -1414,7 +1444,8 @@ class MTRunner(object):
                     for pid, sub in blk.split_by_partition(P).items():
                         out.setdefault(pid, []).append(
                             self.store.register(sub, pin=pin,
-                                                device=feeds_device_fold))
+                                                device=feeds_device_fold,
+                                                handoff=stage_handoff))
                 return out
 
             return push, end
@@ -1428,6 +1459,12 @@ class MTRunner(object):
         # programs instead of the host codec.  claims() re-checks the
         # mapper so a stale/foreign annotation can never dispatch an
         # unrecognized op — the host path below is the guaranteed fallback.
+        # Cross-stage device handoff (plan.lower handoff_analyze): this
+        # stage's output edge keeps program outputs HBM-resident for the
+        # consuming device fold.  A runtime dispatch hint keyed by sid —
+        # deliberately NOT stage options (fingerprints stay
+        # history-independent).
+        stage_handoff = sid is not None and sid in self._handoff_sids
         dev_lowered = False
         lane_program = None
         if stage.options.get("exec_target") == "device":
@@ -1452,7 +1489,9 @@ class MTRunner(object):
                 from .ops import lower as ops_lower
 
                 return ops_lower.device_window_sink(
-                    _clone_op(stage.mapper), self.store)
+                    _clone_op(stage.mapper), self.store,
+                    handoff=stage_handoff,
+                    jobs=stage.options.get("n_maps", self.n_maps))
             return _clone_op(stage.mapper).window_sink()
 
         def job(chunk):
@@ -1493,17 +1532,24 @@ class MTRunner(object):
                            if prof is not None and chain is not None
                            else None)
             push, end = new_sink()
+            dev_sink = None
             if (dev_lowered and not supplementary
                     and (hasattr(chunk, "read_bytes")
                          or hasattr(chunk, "iter_byte_blocks"))):
                 # Device-lowered scan: windows feed double-buffered jitted
                 # programs (ops.lower); the producer thread tokenizes and
                 # dispatches while this thread folds/registers the
-                # vocabulary-sized partials.
-                from .ops.lower import device_map_blocks
+                # vocabulary-sized partials.  Under a handoff="device"
+                # edge the sink accumulates device-resident instead of
+                # emitting — finalize below registers the HBM refs.
+                from .ops import lower as ops_lower
+                from .ops.text import _drive_windows
 
+                dev_sink = ops_lower.device_window_sink(
+                    mapper, self.store, handoff=stage_handoff,
+                    jobs=stage.options.get("n_maps", self.n_maps))
                 for blk in _overlap_stream(
-                        device_map_blocks(mapper, chunk, self.store),
+                        _drive_windows(mapper, chunk, sink=dev_sink),
                         self.store):
                     push(blk)
             elif use_blocks:
@@ -1705,7 +1751,18 @@ class MTRunner(object):
                     for k, v in kvs:
                         push(builder.add(k, v))
                     push(builder.flush())
+            hmap = None
+            if dev_sink is not None:
+                # Device-resident finalize: the accumulated vocabulary
+                # becomes per-partition HBM refs; a budget-degrade flush
+                # block rides the classic combine instead.
+                fblocks, hmap = dev_sink.finalize_handoff(self.store, P)
+                for blk in fblocks:
+                    push(blk)
             out = end()
+            if hmap:
+                for pid, refs in hmap.items():
+                    out.setdefault(pid, []).extend(refs)
             if qrec is not None:
                 qrec.commit()
             return out
@@ -1714,7 +1771,7 @@ class MTRunner(object):
                 feeds_device_fold, sorted_run_mode, window_sink)
 
     def _compact_partitions(self, pset, combine_op, pin, feeds_reduce=True,
-                            device=False):
+                            device=False, handoff=False):
         """Block-count governor (the reference's file-count combiner rounds,
         runner.py:293-320): partitions holding more than max_files_per_stage
         refs merge — re-folding under the stage's associative op when present
@@ -1748,8 +1805,14 @@ class MTRunner(object):
                         # keep the run invariant: merged blocks stay
                         # hash-sorted so streaming reduces can merge them
                         merged = merged.sort_by_hash()
+                    # On a handoff edge the merged block re-enters the
+                    # HBM tier at the edge's floor (the consuming fold
+                    # reads it in place); the fetch above is the
+                    # governor's one bounded host round trip per
+                    # `limit` refs, honestly counted as d2h.
                     merged_refs.append(self.store.register(
-                        merged, pin=pin, device=device))
+                        merged, pin=pin, device=device or handoff,
+                        handoff=handoff))
                 refs = merged_refs
             pset.parts[pid] = refs
 
@@ -1913,10 +1976,28 @@ class MTRunner(object):
                "lane_max": 2 ** 64}
 
         def compact():
-            f = mesh_keyed_refold(mesh, partials, op.kind,
-                                  nonneg=acc["nonneg"])
+            from .parallel.shuffle import compact_partial
+
+            # compact_partial bounds the padded lanes at the distinct-key
+            # count: refold outputs are capacity-padded (~1.5x input,
+            # dead rows included), so re-feeding them uncompacted grows
+            # the accumulated partial geometrically across rounds.
+            f = compact_partial(mesh_keyed_refold(
+                mesh, partials, op.kind, nonneg=acc["nonneg"]))
             del partials[:]
             partials.append(f)
+
+        def maybe_compact():
+            # Compact by accumulated LANE volume, not partial count:
+            # handoff refs are vocabulary-sized (hundreds of tiny
+            # partials are cheaper to hold than to re-fold), while the
+            # window path's partials are capacity-sized and must not
+            # stack past device memory.
+            if len(partials) > 1 and (
+                    len(partials) >= 256
+                    or sum(int(p[0].shape[0]) for p in partials)
+                    >= _REFOLD_LANE_CAP):
+                compact()
 
         def flush(win_blocks):
             blk = Block.concat(win_blocks)
@@ -1972,26 +2053,29 @@ class MTRunner(object):
             elif f[2].dtype != acc["dtype"]:
                 raise _HostPath  # mixed lane dtypes across windows
             partials.append(f)
-            if len(partials) >= _PARTIAL_FANIN:
-                compact()
+            maybe_compact()
 
         _I32 = 2 ** 31 - 1
         _I64 = 2 ** 63 - 1
 
         def flush_dev(ref):
-            """Fold one HBM-resident block without any host lane copy: the
-            device lanes go straight into the collective fold program; the
-            exact-key table merges from the ref's HOST-side metadata (keys
-            + hashes kept at registration); overflow/nonneg bookkeeping
-            uses the registration-time lane_abs/lane_min numbers — the
-            same math flush() runs on host values, sourced where the host
-            array last existed."""
-            from .parallel.shuffle import mesh_keyed_fold_dev
+            """Queue one HBM-resident block for the collective fold
+            without any host lane copy: the device lanes ride straight
+            into the refold as a raw partial (``ok`` marks the valid
+            prefix — handoff refs may carry pow2-padded lanes); the
+            exact-key table merges from the ref's HOST-side metadata
+            (keys + hashes kept at registration); overflow/nonneg
+            bookkeeping uses the registration-time lane_abs/lane_min
+            numbers — the same math flush() runs on host values, sourced
+            where the host array last existed.  One deterministic final
+            refold replaces the former per-ref fold programs, so compile
+            buckets stay bounded regardless of ref count or arrival
+            order."""
+            import jax as _jax
 
             dv, dh1, dh2 = ref.device_lanes()
             keys, h1, h2 = ref.host_meta()
             lane_dt = np.dtype(dv.dtype)
-            nonneg = False
             if lane_dt.kind in "iu":
                 acc["lane_max"] = min(acc["lane_max"],
                                       int(np.iinfo(lane_dt).max))
@@ -2005,30 +2089,18 @@ class MTRunner(object):
                 if acc["nonneg"] and (lane_dt.kind != "i"
                                       or ref.lane_min < 0):
                     acc["nonneg"] = False
-                # Per-window scan-lowering eligibility (mirrors
-                # mesh_keyed_fold's own nonneg gate, from stored metadata).
-                if (op.kind == "sum" and lane_dt.kind == "i"
-                        and ref.lane_min >= 0):
-                    # x64 lane_abs is a float64 estimate: apply the same
-                    # margin flush() uses so a sum one ulp past the lane
-                    # bound can never wrongly qualify for the scan lowering.
-                    if lane_dt == np.int32:
-                        nonneg = (True if not x64
-                                  else ref.lane_abs * (1 + 1e-6) + 1 <= _I32)
-                    elif lane_dt == np.int64:
-                        nonneg = ref.lane_abs * (1 + 1e-6) + 1 <= _I64
             else:
                 acc["nonneg"] = False
             merge_table(keys, h1, h2)
-            f = mesh_keyed_fold_dev(mesh, dh1, dh2, dv, op.kind,
-                                    nonneg=nonneg)
             if acc["dtype"] is None:
-                acc["dtype"] = f[2].dtype
-            elif f[2].dtype != acc["dtype"]:
+                acc["dtype"] = dv.dtype
+            elif dv.dtype != acc["dtype"]:
                 raise _HostPath  # mixed lane dtypes across windows
-            partials.append(f)
-            if len(partials) >= _PARTIAL_FANIN:
-                compact()
+            n_lanes = int(dv.shape[0])
+            ok = np.zeros(n_lanes, dtype=np.uint32)
+            ok[:len(ref)] = 1
+            partials.append((dh1, dh2, dv, _jax.device_put(ok)))
+            maybe_compact()
 
         try:
             win, wbytes = [], 0
@@ -2062,19 +2134,23 @@ class MTRunner(object):
             log.info("mesh fold: falling back to the host path")
             return None
 
-        # One fetch for the whole reduce: mask the final partial's live rows.
-        rh1, rh2, rv, rok = partials[0]
-        mask = np.asarray(rok) == 1
-        fh1 = np.asarray(rh1)[mask]
-        fh2 = np.asarray(rh2)[mask]
-        fv = np.asarray(rv)[mask]
-        # Vectorized hash -> key join against the compacted table (every
-        # output hash entered the table with its window).
-        tu, tk = table_compact()
-        fu = combine64(fh1, fh2)
-        idx = np.minimum(np.searchsorted(tu, fu), len(tu) - 1)
-        assert bool(np.all(tu[idx] == fu)), "mesh fold lost a key"
-        out_keys = tk.take(idx)
+        # One fetch for the whole reduce: mask the final partial's live
+        # rows.  The async refold dispatches materialize here, so this IS
+        # the stage's final fold work (the span the host combine path
+        # emits at its own final fold).
+        with _trace.span("fold", "final-fold"):
+            rh1, rh2, rv, rok = partials[0]
+            mask = np.asarray(rok) == 1
+            fh1 = np.asarray(rh1)[mask]
+            fh2 = np.asarray(rh2)[mask]
+            fv = np.asarray(rv)[mask]
+            # Vectorized hash -> key join against the compacted table
+            # (every output hash entered the table with its window).
+            tu, tk = table_compact()
+            fu = combine64(fh1, fh2)
+            idx = np.minimum(np.searchsorted(tu, fu), len(tu) - 1)
+            assert bool(np.all(tu[idx] == fu)), "mesh fold lost a key"
+            out_keys = tk.take(idx)
 
         pin = bool(stage.options.get("memory"))
         pset, nrec = self._emit_keyed_fold(out_keys, fv, fh1, fh2, pin)
@@ -2996,6 +3072,15 @@ class MTRunner(object):
                     "device_stages", 0),
                 "lowered": bool(((self.plan_report or {}).get("lowering")
                                  or {}).get("enabled")),
+                # Cross-stage handoff evidence: device bytes registered
+                # without a host round-trip, drain bytes the table
+                # programs never fetched, edges the plan marked
+                # handoff="device", and runtime degrades back to spill.
+                "handoff_bytes": sto.handoff_bytes,
+                "d2h_avoided_bytes": sto.d2h_avoided_bytes,
+                "handoff_edges": (self.plan_report or {}).get(
+                    "handoff_edges", 0),
+                "handoff_degrades": sto.handoff_degrades,
             },
             "streamed_assoc_folds": self.streamed_assoc_folds,
             "retries": self.retries_total,
@@ -3121,6 +3206,14 @@ class MTRunner(object):
                 self.store.abort_writes()
             except Exception:
                 log.warning("spill writer abort failed", exc_info=True)
+            # HBM residents die with the run: a killed run's device
+            # lanes are never consumed, and holding them would leak the
+            # shared device budget (the handoff tier keeps whole
+            # vocabularies resident mid-stage).
+            try:
+                self.store.release_device()
+            except Exception:
+                log.warning("device release failed", exc_info=True)
             raise
         finally:
             guard.close()

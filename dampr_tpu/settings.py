@@ -464,6 +464,57 @@ lower_batch = int(os.environ.get("DAMPR_TPU_LOWER_BATCH", str(1 << 18)))
 lower_min_records = int(os.environ.get(
     "DAMPR_TPU_LOWER_MIN_RECORDS", "4096"))
 
+#: Cross-stage device-resident handoff (docs/plan.md "Cross-stage device
+#: fusion"): when the plan lowers an adjacent map producer AND its
+#: consuming associative fold to the device, the producer's program
+#: outputs stay HBM-resident and the fold consumes them in place —
+#: skipping the d2h fetch, pickle, frame encode/decode, spill, and h2d
+#: re-upload the host spill path would pay on that edge.  "auto"
+#: (default) engages whenever lowering is in force AND either the HBM
+#: tier has budget (a real accelerator) or lowering was explicitly
+#: forced (the CPU-JAX jit leg: device memory IS host memory there, so
+#: residency is free) — but an explicit ``hbm_budget=0`` declines auto
+#: ("no device residency" wins); "on"/"1" force it, "off"/"0" disable
+#: it.  Every
+#: fallback (HBM budget exceeded, vocabulary overflow, 64-bit hash
+#: collision, non-lowered consumer at run time) degrades that edge — or
+#: just that batch — to the existing spill path byte-identically.
+handoff = os.environ.get("DAMPR_TPU_HANDOFF", "auto")
+
+
+def handoff_forced():
+    return str(handoff).lower() in ("on", "1", "true", "yes")
+
+
+def handoff_enabled():
+    """Is the cross-stage device handoff tier in force?  Auto follows the
+    lowering decision: enabled when stages lower AND device residency is
+    either budgeted (HBM budget > 0) or free (the forced CPU-JAX leg) —
+    but an EXPLICIT ``hbm_budget=0`` ("no device residency") always
+    declines auto; only a forced ``handoff=on`` overrides it."""
+    s = str(handoff).lower()
+    if s in ("off", "0", "false", "no"):
+        return False
+    if s in ("on", "1", "true", "yes"):
+        return True
+    if str(hbm_budget).lower() != "auto" and effective_hbm_budget() == 0:
+        return False
+    return lower_enabled() and (effective_hbm_budget() > 0
+                                or lower_forced())
+
+
+def effective_handoff_budget():
+    """Device bytes the handoff tier may keep resident: the HBM budget
+    when the tier is funded, else (forced / forced-lowering CPU legs,
+    where device RAM is host RAM) the run's stage memory budget."""
+    b = effective_hbm_budget()
+    if b > 0:
+        return b
+    if handoff_enabled():
+        return max_memory_per_stage
+    return 0
+
+
 #: Route the lowered program's segment-count step through the Pallas
 #: fused segfold kernel (ops/pallas_segfold.py) instead of the XLA scan
 #: lowering.  Off by default until benchmarks/pallas_bench.py measures a

@@ -73,11 +73,12 @@ class BlockRef(object):
     __slots__ = ("_block", "_packed", "path", "nbytes", "nrecords",
                  "value_dtype", "key_dtype", "store", "pin",
                  "_dev", "_kmeta", "dev_bytes", "lane_abs", "lane_min",
-                 "_dead")
+                 "_dead", "_h2d_pending")
 
     def __init__(self, block, store=None, pin=False, device_prep=None):
         self._packed = None
         self._dead = False
+        self._h2d_pending = 0
         self.nrecords = len(block)
         self.value_dtype = block.values.dtype  # metadata survives spilling
         self.key_dtype = block.keys.dtype
@@ -157,6 +158,10 @@ class BlockRef(object):
             self._dev = (jax.device_put(lane_vals), jax.device_put(h1),
                          jax.device_put(h2))
         self.dev_bytes = lane_vals.nbytes + h1.nbytes + h2.nbytes
+        # Boundary accounting is per actual transfer, never per
+        # registration: the store drains this pending charge exactly once
+        # (a ref re-registered after a fallback adds nothing).
+        self._h2d_pending = self.dev_bytes
         self._kmeta = (block.keys, h1, h2)
         self._block = None
         # Host budget is charged for what stays host-resident; object key
@@ -200,6 +205,42 @@ class BlockRef(object):
         return freed, self.nbytes - old_host
 
     @classmethod
+    def from_device_lanes(cls, keys, h1, h2, dev_vals, dev_h1, dev_h2,
+                          store=None, value_dtype=None, lane_abs=None,
+                          lane_min=None, h2d_bytes=0):
+        """Build an HBM-resident ref straight from ALREADY-device-resident
+        lanes (the cross-stage handoff tier): a lowered producer's program
+        outputs become the consuming fold's input without ever leaving the
+        device.  ``keys``/``h1``/``h2`` are the host routing metadata
+        (the same ``_kmeta`` contract as ``_put_device``);
+        ``value_dtype`` is the dtype ``get()`` materializes on the host
+        fallback path (what the spill path would have registered);
+        ``h2d_bytes`` charges only what was genuinely uploaded to
+        assemble the ref (hash lanes), never the value lane — it was
+        already resident."""
+        ref = cls.__new__(cls)
+        ref._packed = None
+        ref._dead = False
+        ref.path = None
+        ref.nrecords = len(keys)
+        ref.value_dtype = (np.dtype(value_dtype) if value_dtype is not None
+                           else np.dtype(dev_vals.dtype))
+        ref.key_dtype = keys.dtype
+        ref.store = store
+        ref.pin = False
+        ref._dev = (dev_vals, dev_h1, dev_h2)
+        ref._kmeta = (keys, h1, h2)
+        ref._block = None
+        ref.dev_bytes = int(dev_vals.nbytes + dev_h1.nbytes
+                            + dev_h2.nbytes)
+        ref._h2d_pending = int(h2d_bytes)
+        ref.lane_abs = lane_abs
+        ref.lane_min = lane_min
+        kb = (keys.nbytes if keys.dtype != object else len(keys) * 64)
+        ref.nbytes = kb + h1.nbytes + h2.nbytes
+        return ref
+
+    @classmethod
     def from_disk(cls, path, nrecords, nbytes, key_dtype, value_dtype):
         """Rebuild a disk-backed ref from checkpoint-manifest metadata
         (resume.py): no RAM residency, reads stream from ``path``."""
@@ -221,6 +262,7 @@ class BlockRef(object):
         ref.lane_abs = None
         ref.lane_min = None
         ref._dead = False
+        ref._h2d_pending = 0
         return ref
 
     def __len__(self):
@@ -521,6 +563,14 @@ class RunStore(object):
         self.d2h_bytes = 0
         self.hbm_offloads = 0
         self.hbm_peak_bytes = 0
+        # Cross-stage handoff tier (docs/plan.md "Cross-stage device
+        # fusion"): device bytes registered WITHOUT a host round-trip,
+        # the drain bytes the table-mode programs never fetched, and how
+        # many times an edge degraded back to the spill path.
+        self.handoff_active = False   # set by the runner per plan
+        self.handoff_bytes = 0
+        self.d2h_avoided_bytes = 0
+        self.handoff_degrades = 0
         # Overlap executor accounting: bytes of in-flight scan windows /
         # codec output the pipelined map driver holds ahead of the fold.
         # Charged against the same budget as resident blocks (reserving
@@ -548,6 +598,17 @@ class RunStore(object):
     def count_d2h(self, n):
         with self._lock:
             self.d2h_bytes += n
+
+    def count_d2h_avoided(self, n):
+        """Drain bytes a handoff-mode program batch kept device-resident
+        that the classic path would have fetched (the lowered edge's
+        evidence counter)."""
+        with self._lock:
+            self.d2h_avoided_bytes += n
+
+    def count_handoff_degrade(self):
+        with self._lock:
+            self.handoff_degrades += 1
 
     def count_h2d(self, n):
         """Feed bytes shipped to device outside the HBM-tier register path
@@ -682,6 +743,14 @@ class RunStore(object):
             self._overlap_bytes = max(0, self._overlap_bytes - n)
 
     def hbm_budget(self):
+        """HBM residency budget for this run.  When the plan produced
+        device-handoff edges (``handoff_active``), the handoff budget
+        applies — on forced CPU-JAX legs the plain HBM budget resolves to
+        0 and would instantly offload the very refs the handoff tier just
+        kept resident.  Runs without handoff edges keep the classic
+        budget byte-for-byte."""
+        if self.handoff_active:
+            return settings.effective_handoff_budget()
         return settings.effective_hbm_budget()
 
     @contextlib.contextmanager
@@ -716,13 +785,35 @@ class RunStore(object):
     def set_stage(self, stage_name):
         self._stage = "stage_{}".format(stage_name)
 
-    def register(self, block, pin=False, device=False):
+    def register(self, block, pin=False, device=False, handoff=False):
         prep = None
+        # hbm_min_records is a perf heuristic (tiny lanes aren't worth
+        # the tier bookkeeping); a plan-decided handoff edge overrides
+        # it — the edge's whole point is that the consuming fold reads
+        # these lanes in place.
+        floor = 1 if handoff else settings.hbm_min_records
         if (device and not pin and settings.use_device
                 and self.hbm_budget() > 0
-                and len(block) >= settings.hbm_min_records):
+                and len(block) >= floor):
             prep = BlockRef.lane_prep(block.values)
         ref = BlockRef(block, store=self, pin=pin, device_prep=prep)
+        # handoff only overrides the tier FLOOR here: these blocks came
+        # through a host round trip (degrade flushes, compaction
+        # merges), so they never count toward handoff_bytes — that
+        # counter means "registered WITHOUT a host round-trip" and only
+        # register_device() feeds it.
+        return self._enter_ref(ref, handoff=False)
+
+    def register_device(self, ref):
+        """Register an already-assembled HBM-resident ref
+        (:meth:`BlockRef.from_device_lanes` — the cross-stage handoff
+        tier).  Same budget/attempt/metrics discipline as
+        :meth:`register`; the value lane never crossed the boundary, so
+        only the ref's pending hash-lane upload charges h2d."""
+        ref.store = self
+        return self._enter_ref(ref, handoff=True)
+
+    def _enter_ref(self, ref, handoff=False):
         if _metrics.enabled():
             # Stage-output throughput: every materialized block crosses
             # here, so records/s and MB/s difference off these counters
@@ -738,7 +829,15 @@ class RunStore(object):
             if ref.is_device:
                 self._dev_resident.append(ref)
                 self._dev_bytes += ref.dev_bytes
-                self.h2d_bytes += ref.dev_bytes
+                # h2d is charged per actual transfer (the ref's pending
+                # counter, armed where the device_put happened), so a
+                # ref re-registered after a fallback — or assembled from
+                # already-resident program outputs — never double-counts
+                # the boundary.
+                self.h2d_bytes += ref._h2d_pending
+                ref._h2d_pending = 0
+                if handoff:
+                    self.handoff_bytes += ref.dev_bytes
                 self.hbm_peak_bytes = max(self.hbm_peak_bytes,
                                           self._dev_bytes)
                 dev_victims = self._select_dev_victims_locked()
@@ -852,6 +951,23 @@ class RunStore(object):
         _trace.complete("merge", "merge-run", t0, bytes=total_bytes,
                         records=total_records)
         return ref
+
+    def release_device(self):
+        """Drop every HBM-resident ref and return the device budget to
+        zero — the failing/killed-run path.  HBM is shared across runs
+        on a real accelerator, and a dead run's lanes are never
+        consumed, so refs die outright (no offload copy: there is
+        nothing to preserve)."""
+        with self._lock:
+            victims = list(self._dev_resident)
+            self._dev_resident = []
+            self._dev_bytes = 0
+            for ref in victims:
+                if ref in self._resident:
+                    self._resident.remove(ref)
+                    self._resident_bytes -= ref.nbytes
+        for ref in victims:
+            ref.delete()
 
     def _select_dev_victims_locked(self):
         """Oldest device refs past the HBM budget offload to host (the HBM

@@ -455,13 +455,19 @@ class TestPlanAnalysis:
 class TestObservability:
     def test_device_span_and_stats_section(self, corpus, tmp_path):
         old_trace, old_dir = settings.trace, settings.trace_dir
+        old_handoff = settings.handoff
         settings.trace = True
         settings.trace_dir = str(tmp_path / "traces")
+        # This test pins the CLASSIC device-lowered surface (device
+        # spans, boundary bytes); the handoff tier replaces exactly
+        # those on its edge and has its own pins (test_handoff).
+        settings.handoff = "off"
         try:
             _got, stats = _tfidf(corpus, "lowertest-traced")
         finally:
             settings.trace = old_trace
             settings.trace_dir = old_dir
+            settings.handoff = old_handoff
         assert stats["device"]["device_stages"] >= 1
         assert stats["device"]["h2d_bytes"] > 0
         assert stats["device"]["d2h_bytes"] > 0

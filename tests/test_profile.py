@@ -165,7 +165,13 @@ class TestDeviceSubPhases:
         from dampr_tpu.ops.text import TokenCounts
 
         old = settings.lower
+        old_handoff = settings.handoff
         settings.lower = "1"
+        # The classic dispatch loop is what decomposes into these four
+        # brackets; the handoff tier's bootstrap/probe path replaces it
+        # on this edge and has its own observability pins
+        # (test_handoff).
+        settings.handoff = "off"
         try:
             # pair_values=False + fold_values is the device-eligible
             # map->fold shape (the bench's): no Rekey between scanner
@@ -193,6 +199,7 @@ class TestDeviceSubPhases:
             em.delete()
         finally:
             settings.lower = old
+            settings.handoff = old_handoff
 
 
 class TestProfilerUnit:
