@@ -1,0 +1,173 @@
+"""Cross-run reuse benchmark (docs/reuse.md): cold run vs identical
+re-run vs +10% appended corpus, with byte-identity asserted against a
+cache-off oracle at every leg.
+
+Three legs over one word-frequency pipeline (the TF-IDF document-
+frequency stage shape):
+
+1. **cold** — empty cache; every stage executes and publishes.
+2. **identical** — same corpus; the whole chain should mount from the
+   cache (the headline number: ``identical_rerun_speedup``).
+3. **append** — ~``--append-fraction`` new files; the scan stage reruns
+   only the delta and merges partials with the cached frames
+   (associativity certified by ``analyze/assoc``), then is compared
+   against a cold cache-off run of the appended corpus.
+
+Byte-identity violations exit non-zero — this bench is a correctness
+witness first and a perf gate second.
+
+    python benchmarks/incremental_bench.py --mb 8
+"""
+
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
+import argparse
+import hashlib
+import json
+import operator
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "theta",
+         "kappa", "lambda", "sigma", "token", "frame", "spill", "merge"]
+
+
+def make_corpus(d, mb, nfiles=8, offset=0):
+    """Deterministic text corpus split over ``nfiles`` files; ``offset``
+    shifts the word schedule so appended files carry fresh content."""
+    os.makedirs(d, exist_ok=True)
+    per_file = int(mb * 1024 ** 2 / nfiles)
+    paths = []
+    for i in range(nfiles):
+        path = os.path.join(d, "part-{:04d}.txt".format(offset + i))
+        paths.append(path)
+        with open(path, "w") as f:
+            written = 0
+            j = offset * 1000 + i
+            while written < per_file:
+                row = " ".join(WORDS[(j + k * 3) % len(WORDS)]
+                               for k in range(9))
+                line = "{} doc{}\n".format(row, j % 257)
+                f.write(line)
+                written += len(line)
+                j += 1
+    return paths
+
+
+def build(corpus_dir):
+    from dampr_tpu import Dampr
+    from dampr_tpu.ops.text import DocFreq
+
+    return (Dampr.text(corpus_dir)
+            .custom_mapper(DocFreq(mode="word", lower=True))
+            .fold_by(lambda kv: kv[0], operator.add, lambda kv: kv[1]))
+
+
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook: the bench's pipeline shape
+    (constructed over this source file; nothing runs)."""
+    return [("incremental_bench", build(__file__))]
+
+
+def run_leg(corpus_dir, name):
+    t0 = time.time()
+    out = build(corpus_dir).run(name)
+    rows = sorted(out.stream())
+    secs = time.time() - t0
+    digest = hashlib.sha256(
+        "\n".join(repr(r) for r in rows).encode()).hexdigest()
+    return secs, digest, (out.stats() or {}).get("reuse") or {}
+
+
+def oracle(corpus_dir, name):
+    """Cache-off cold run: the byte-identity reference."""
+    from dampr_tpu import settings
+
+    old = settings.reuse
+    settings.reuse = "off"
+    try:
+        return run_leg(corpus_dir, name)
+    finally:
+        settings.reuse = old
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=8.0,
+                    help="corpus size in MB (pre-append)")
+    ap.add_argument("--append-fraction", type=float, default=0.10)
+    ap.add_argument("--dir", default=None,
+                    help="working dir (default: fresh tempdir, removed "
+                         "on exit)")
+    args = ap.parse_args()
+
+    from dampr_tpu import settings
+
+    work = args.dir or tempfile.mkdtemp(prefix="dampr-incr-bench-")
+    corpus = os.path.join(work, "corpus")
+    shutil.rmtree(corpus, ignore_errors=True)
+    # Fresh cache + scratch per invocation: the bench MEASURES the cold
+    # leg, so a warm shared cache would corrupt it.  plan_adapt off so
+    # the identical leg keys identically to the cold leg (history-driven
+    # option changes legitimately shift the reuse key).
+    settings.scratch_root = os.path.join(work, "scratch")
+    settings.reuse_dir = os.path.join(work, "reuse-cache")
+    settings.reuse = "on"
+    settings.plan_adapt = False
+
+    nfiles = 10
+    make_corpus(corpus, args.mb, nfiles=nfiles)
+
+    cold_s, cold_d, cold_ru = run_leg(corpus, "incr-bench")
+    warm_s, warm_d, warm_ru = run_leg(corpus, "incr-bench")
+    if warm_d != cold_d:
+        print("BYTE-IDENTITY VIOLATION: identical re-run diverged",
+              file=sys.stderr)
+        sys.exit(1)
+    if not warm_ru.get("hits"):
+        print("REUSE MISS: identical re-run took no cache hits: {}"
+              .format(warm_ru), file=sys.stderr)
+        sys.exit(1)
+
+    n_append = max(1, int(round(nfiles * args.append_fraction)))
+    make_corpus(corpus, args.mb * args.append_fraction,
+                nfiles=n_append, offset=nfiles)
+    incr_s, incr_d, incr_ru = run_leg(corpus, "incr-bench")
+    oracle_s, oracle_d, _ = oracle(corpus, "incr-bench-oracle")
+    if incr_d != oracle_d:
+        print("BYTE-IDENTITY VIOLATION: incremental run diverged from "
+              "the cold oracle", file=sys.stderr)
+        sys.exit(1)
+
+    decided = len(warm_ru.get("decisions") or ()) or 1
+    print(json.dumps({
+        "metric": "identical_rerun_speedup",
+        "value": round(cold_s / warm_s, 2) if warm_s > 1e-9 else 0.0,
+        "unit": "x",
+        "corpus_mb": args.mb,
+        "append_fraction": args.append_fraction,
+        "wall_cold_seconds": round(cold_s, 3),
+        "wall_identical_seconds": round(warm_s, 3),
+        "wall_incremental_seconds": round(incr_s, 3),
+        "wall_appended_cold_seconds": round(oracle_s, 3),
+        "incremental_vs_cold_fraction": (
+            round(incr_s / oracle_s, 3) if oracle_s > 1e-9 else 0.0),
+        "reuse_hit_fraction": round(
+            (warm_ru.get("hits") or 0) / decided, 3),
+        "identical_hits": warm_ru.get("hits"),
+        "identical_bytes_mounted": warm_ru.get("bytes_mounted"),
+        "incremental_merges": incr_ru.get("incremental_merges"),
+        "cold_bytes_published": cold_ru.get("bytes_published"),
+        "byte_identical": True,
+        "digest": cold_d,
+    }))
+    if args.dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

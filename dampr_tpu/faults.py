@@ -84,6 +84,7 @@ SITES = (
     "sampler_tick",     # obs/sampler.py loop (slow-stop shutdown tests)
     "progress_tick",    # obs/progress.py loop
     "overlap_produce",  # runner._overlap_stream producer (race widener)
+    "cache_read",       # plan/reuse.py manifest/block reads (degrade path)
 )
 
 

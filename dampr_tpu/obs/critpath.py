@@ -68,6 +68,10 @@ _RESOURCE_BY_CAT = {
     "handoff": "device",
     "stall": "overlap-stall",
     "checkpoint": "checkpoint",
+    # Reuse-cache spans (mount hardlinking, delta re-runs, publishes)
+    # are checkpoint-shaped work: durable materialization IO, never
+    # productive compute — same tie-break tier as checkpoints.
+    "reuse": "checkpoint",
 }
 
 #: Verdicts that may be *covered* by other work happening concurrently:

@@ -247,6 +247,25 @@ _PLAYBOOK = {
          "for the associative collective fold, tune exchange capacity "
          "or keep the shuffle on host (DAMPR_TPU_MESH_EXCHANGE=off)"),
     ],
+    "reuse-thrash": [
+        ("reuse_budget_bytes", "DAMPR_TPU_REUSE_BUDGET",
+         lambda cur: max(2 * 1024 ** 3, int(cur or 0) * 2),
+         "the shared materialization cache evicted entries as fast as "
+         "it published them — a larger byte budget lets warm prefixes "
+         "survive to the next run instead of churning"),
+        ("reuse_dir", "DAMPR_TPU_REUSE_DIR",
+         lambda cur: None,
+         "or point the cache at a volume with room: eviction pressure "
+         "often means the scratch filesystem is shared with spill "
+         "traffic"),
+    ],
+    "reuse-off": [
+        ("reuse", "DAMPR_TPU_REUSE",
+         lambda cur: "on",
+         "the run corpus shows this exact plan shape executed before — "
+         "with the cross-run cache enabled, unchanged stage prefixes "
+         "mount from disk instead of recomputing"),
+    ],
 }
 
 #: Verdicts that never produce a finding on their own.
@@ -671,6 +690,52 @@ def diagnose(run):
                                             run_settings=run_settings),
         })
 
+    # -- cross-run materialization cache signals (plan/reuse.py) -------------
+    reuse = summary.get("reuse") or {}
+    if reuse.get("enabled"):
+        hits = reuse.get("hits") or 0
+        evictions = reuse.get("evictions") or 0
+        # Thrash: the run published into the cache but eviction churned
+        # at least as much as lookups hit — the budget is too small for
+        # the working set, so the NEXT run's prefixes won't be there.
+        if evictions and evictions >= max(1, hits):
+            findings.append({
+                "stage": None,
+                "bottleneck": "reuse-thrash",
+                "impact_seconds": 0.0,
+                "severity": "medium",
+                "evidence": "reuse cache evicted {} entr{} against {} "
+                            "hit(s) this run ({:.1f} MB published) — "
+                            "the byte budget is churning the working "
+                            "set".format(
+                                evictions,
+                                "y" if evictions == 1 else "ies", hits,
+                                (reuse.get("bytes_published") or 0) / 1e6),
+                "suggestions": _suggestions_for("reuse-thrash", summary,
+                                                run_settings=run_settings),
+            })
+    elif hist:
+        # Missed reuse: the corpus has PRIOR records of this exact plan
+        # fingerprint, but the cache was off — an identical re-run would
+        # have mounted its unchanged prefix instead of recomputing.
+        fp = history.plan_fingerprint(
+            (summary.get("plan") or {}).get("stage_shapes"))
+        prior = [r for r in hist[:-1] if r.get("fingerprint") == fp]
+        if fp and prior:
+            findings.append({
+                "stage": None,
+                "bottleneck": "reuse-off",
+                "impact_seconds": 0.0,
+                "severity": "low",
+                "evidence": "this plan shape has {} prior corpus "
+                            "record(s) with an identical fingerprint "
+                            "but the cross-run cache was disabled — "
+                            "repeated runs recompute unchanged "
+                            "prefixes".format(len(prior)),
+                "suggestions": _suggestions_for("reuse-off", summary,
+                                                run_settings=run_settings),
+            })
+
     findings.sort(key=lambda f: -(f.get("impact_seconds") or 0.0))
     for rank, f in enumerate(findings, 1):
         f["rank"] = rank
@@ -713,6 +778,8 @@ def diagnose(run):
         report["faults"] = fault_section
     if summary.get("mitigation"):
         report["mitigation"] = summary["mitigation"]
+    if summary.get("reuse"):
+        report["reuse"] = summary["reuse"]
     return report
 
 

@@ -96,7 +96,8 @@ _KNOBS = ("analyze", "partitions", "batch_size", "max_memory_per_stage",
           "retry_backoff_ms", "max_quarantined", "exchange_timeout_ms",
           "mitigate", "speculate_threshold", "speculate_after_steps",
           "mitigate_probe_windows", "exchange_coding", "cost_model",
-          "autotune", "autotune_trials", "handoff")
+          "autotune", "autotune_trials", "handoff", "reuse",
+          "reuse_budget_bytes")
 
 
 def corpus_path(run_name):

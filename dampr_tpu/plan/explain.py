@@ -39,6 +39,7 @@ def explain_text(graph, outputs, name=None):
         lines.extend(_target_lines(graph, name, outputs))
         lines.extend(_shuffle_lines(graph, name, outputs))
         lines.extend(_analysis_lines(graph))
+        lines.extend(_reuse_lines(graph))
         return "\n".join(lines)
     optimized, report = passes.optimize(graph, outputs)
     lines.append("== optimized plan ({} executed) =="
@@ -84,6 +85,7 @@ def explain_text(graph, outputs, name=None):
     lines.extend(_target_lines(optimized, name, outputs))
     lines.extend(_shuffle_lines(optimized, name, outputs))
     lines.extend(_analysis_lines(optimized))
+    lines.extend(_reuse_lines(optimized))
     return "\n".join(lines)
 
 
@@ -121,6 +123,45 @@ def _analysis_lines(graph):
         for e in d["evidence"][:3]:
             lines.append("      - {}".format(e))
     return lines
+
+
+def _reuse_lines(graph):
+    """Cross-run materialization cache preview (docs/reuse.md): a
+    READ-ONLY consult of the shared cache with the same key derivation
+    the runner plans with — which stages would mount, which would miss.
+    Best-effort: the preview keys with the static ``settings.partitions``
+    salt (a run that overrides ``n_partitions`` keys differently), and
+    any cache error degrades to a one-line note, never an exception."""
+    if not settings.reuse_enabled():
+        return ["reuse: off (settings.reuse / DAMPR_TPU_REUSE) — every "
+                "run recomputes from its inputs"]
+    from ..graph import GSink
+    from . import reuse as _reuse
+
+    try:
+        keys, _structs, _sigs = _reuse.reuse_keys(
+            graph, "p{}".format(settings.partitions))
+        cache = _reuse.CacheStore()
+        lines = ["reuse: cache {} ({:.1f} MB used, budget {:.1f} MB)"
+                 .format(cache.root, cache.total_bytes() / 1e6,
+                         cache.budget / 1e6)]
+        for sid, stage in enumerate(graph.stages):
+            if isinstance(stage, (GInput, GSink)):
+                continue
+            if _reuse._resume.is_volatile(keys[sid]):
+                lines.append("  s{}: volatile (never cached)".format(sid))
+                continue
+            try:
+                hit = cache.lookup(keys[sid]) is not None
+            except _reuse.CacheEntryError:
+                lines.append("  s{}: corrupt entry (would recompute)"
+                             .format(sid))
+                continue
+            lines.append("  s{}: {}".format(
+                sid, "cached (would mount)" if hit else "miss"))
+        return lines
+    except Exception as exc:  # pure preview: never break explain()
+        return ["reuse: preview unavailable ({})".format(exc)]
 
 
 def _cost_lines(graph, name):

@@ -861,6 +861,11 @@ class MTRunner(object):
         # Failed runs must not feed the run-history corpus (their
         # measurements would poison the adaptation medians).
         self._run_failed = False
+        # Cross-run materialization cache (plan/reuse.py,
+        # settings.reuse): the live decision/byte counters that land as
+        # stats()["reuse"].  None while the cache is off keeps untouched
+        # runs free of the section (back-compat pin).
+        self._reuse_summary = None
 
     # -- job fan-out --------------------------------------------------------
     def _speculation_ok(self, *stages):
@@ -3097,6 +3102,12 @@ class MTRunner(object):
             "trace_file": None,
             "stats_file": None,
         })
+        if self._reuse_summary is not None:
+            # Cross-run cache evidence (plan/reuse.py): hits, bytes
+            # mounted/published, incremental merges, recompute
+            # fallbacks, and the per-stage decision list — what the
+            # reuse-smoke CI leg and the doctor findings read.
+            summary["reuse"] = self._reuse_summary
         if self._mitigation is not None:
             # What the skew signal made the engine DO: speculative wins,
             # stolen partitions, skipped collective windows, sticky
@@ -3295,11 +3306,31 @@ class MTRunner(object):
             if plan:
                 log.info("resume: %d stage(s) restorable from %s",
                          len(plan), self.store.root)
+        # Cross-run materialization cache (plan/reuse.py): decisions and
+        # mounts happen HERE, before the need-set walk, so a corrupted
+        # entry degrades to a normal recompute while its prefix is still
+        # scheduled.  Best-effort by design: any failure disarms the
+        # cache for this run and the run proceeds cold.
+        reuse_ctl = None
+        if settings.reuse_enabled():
+            from .plan import reuse as _reuse
+
+            try:
+                reuse_ctl = _reuse.RunReuse(self, outputs)
+                reuse_ctl.plan(outputs, satisfied=plan)
+                self._reuse_summary = reuse_ctl.summary
+            except Exception:
+                log.warning("reuse cache disabled for this run",
+                            exc_info=True)
+                reuse_ctl = None
+        if self.resume or (reuse_ctl is not None
+                           and (reuse_ctl.mounted or reuse_ctl.incremental)):
             # Lazy need-set: a stage executes only if its output feeds a
             # stage that executes (or is itself requested / an effectful
-            # sink) AND it was not restored.  Without this, a rerun whose
-            # intermediates were cleaned up would recompute the whole chain
-            # below its one surviving (final-output) checkpoint.
+            # sink) AND it was not restored or mounted.  Without this, a
+            # rerun whose intermediates were cleaned up would recompute
+            # the whole chain below its one surviving (final-output)
+            # checkpoint — or below a cache hit.
             required = set()
             needed = set(outputs)
             for sid in range(n_stages - 1, -1, -1):
@@ -3312,6 +3343,11 @@ class MTRunner(object):
                 required.add(sid)
                 if sid in plan:
                     continue  # restored from checkpoint: inputs not needed
+                if reuse_ctl is not None and sid in reuse_ctl.mounted:
+                    continue  # mounted from the shared cache
+                if reuse_ctl is not None and sid in reuse_ctl.incremental:
+                    continue  # delta re-run reads only its tap (GInput
+                    #           sources always populate env below)
                 needed.update(stage.inputs)
         for sid, stage in enumerate(self.graph.stages):
             t0 = time.time()
@@ -3369,6 +3405,42 @@ class MTRunner(object):
                                 t0_span, lane="stages", records=nrec)
                 log.info("Stage %s resumed: %s", sid + 1, st.as_dict())
                 continue
+            if reuse_ctl is not None and reuse_ctl.handles(sid):
+                out = None
+                try:
+                    out = reuse_ctl.apply(sid, stage, env)
+                except Exception:
+                    # Exactness contract: a cache entry that fails mid-
+                    # apply degrades to recompute, never to wrong
+                    # results — fall through to normal execution (the
+                    # need-set kept an incremental stage's tap input
+                    # live; full mounts were validated at plan time).
+                    log.warning("reuse: stage %s falls back to recompute",
+                                sid + 1, exc_info=True)
+                    reuse_ctl.note_fallback(sid)
+                if out is not None:
+                    result, nrec, rkind = out
+                    env[stage.output] = result
+                    if not isinstance(stage, GSink):
+                        to_delete.append(stage.output)
+                    # Mounted frames persist no resume manifest: their
+                    # scratch hardlinks must be DELETED (not released)
+                    # at cleanup, exactly like volatile stages' blocks.
+                    volatile_sources.add(stage.output)
+                    self.store.drain_writes()
+                    st = StageStats(sid, rkind + "-" + (
+                        "map" if isinstance(stage, GMap) else
+                        "reduce" if isinstance(stage, GReduce) else "sink"))
+                    st.n_jobs = 0
+                    st.records_out = nrec
+                    st.seconds = time.time() - t0
+                    self._fill_stage_io(st, stage, env, result, snap)
+                    self.stats.append(st)
+                    _trace.complete("stage", "s{}:{}".format(sid, st.kind),
+                                    t0_span, lane="stages", records=nrec)
+                    log.info("Stage %s %s from reuse cache: %s", sid + 1,
+                             rkind, st.as_dict())
+                    continue
             if isinstance(stage, GMap):
                 if (sid not in fused
                         and len(stage.inputs) == 1
@@ -3411,7 +3483,9 @@ class MTRunner(object):
                     group = [g for g in self._scan_share_group(
                         sid, stage, env)
                         if g[0] not in plan
-                        and (required is None or g[0] in required)]
+                        and (required is None or g[0] in required)
+                        and (reuse_ctl is None
+                             or not reuse_ctl.handles(g[0]))]
                     if group:
                         members = [(sid, stage)] + group
                         outs = self.run_map_group(
@@ -3446,6 +3520,12 @@ class MTRunner(object):
                     self.store, sid, stage_fps[sid], result, nrec)
                 if _resume.is_volatile(stage_fps[sid]):
                     volatile_sources.add(stage.output)
+            if reuse_ctl is not None:
+                # Cross-run publish rides the same settled-refs barrier
+                # as checkpoint persistence: on-disk blocks hardlink in
+                # for free, RAM blocks encode once.  Never fails the
+                # run; chaos/quarantined runs are gated off inside.
+                reuse_ctl.maybe_publish(sid, stage, result, nrec)
             # Ride the plan's shuffle choice on the stage's materialized
             # partitions: lazily-read sorted outputs (sort_by) decide
             # host-vs-mesh range redistribution at read time, after the

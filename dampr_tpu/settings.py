@@ -389,6 +389,43 @@ def autotune_enabled():
 #: session never runs more than this many measured executions.
 autotune_trials = int(os.environ.get("DAMPR_TPU_AUTOTUNE_TRIALS", "4"))
 
+#: Cross-run materialization cache (dampr_tpu.plan.reuse): "on"/"1"
+#: consults (and publishes to) the shared content-addressed stage cache
+#: under ``reuse_dir`` so identical pipeline prefixes — across runs,
+#: run NAMES, and processes — mount cached partition frames instead of
+#: recomputing, and append-only input growth re-runs only the new
+#: chunks.  "auto" (default) currently resolves OFF — it is reserved
+#: for the serve daemon (ROADMAP item 1), which will resolve it on for
+#: deduped submissions; "0"/"off" pins the cache fully out of the path
+#: (plans, fingerprints, and results are byte-identical either way —
+#: the reuse-off CI leg asserts exactly that).
+reuse = os.environ.get("DAMPR_TPU_REUSE", "auto")
+
+
+def reuse_enabled():
+    return str(reuse).lower() in ("on", "1", "true", "yes")
+
+
+#: Byte budget for the shared reuse cache directory.  Publishing past
+#: the budget evicts least-recently-consumed entries (whole entries,
+#: never single blocks) under the store's exclusive flock; mounted runs
+#: are immune — consumers hardlink cached frames into their own scratch
+#: before reading.
+reuse_budget_bytes = int(os.environ.get("DAMPR_TPU_REUSE_BUDGET",
+                                        str(2 * 1024 ** 3)))
+
+#: Shared reuse-cache directory.  Empty (default) resolves to
+#: ``<scratch_root>/reuse-cache`` at use time, so tests that repoint
+#: scratch_root isolate their cache with it; co-located runs that
+#: should SHARE materializations point this at one common directory.
+reuse_dir = os.environ.get("DAMPR_TPU_REUSE_DIR", "")
+
+#: Content-signature chunk granularity (bytes): input files are
+#: fingerprinted in windows of this size, and append-only growth is
+#: detected as a signature whose chunk list extends a cached prefix.
+reuse_chunk_bytes = int(os.environ.get("DAMPR_TPU_REUSE_CHUNK",
+                                       str(16 * 1024 ** 2)))
+
 #: Deterministic seeding for ``sample(prob)``: None (default) keeps the
 #: historical behavior — each worker thread draws from a time-seeded RNG,
 #: so sampled pipelines are NOT reproducible run to run.  An int seeds

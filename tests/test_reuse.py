@@ -1,0 +1,378 @@
+"""Cross-run materialization cache (dampr_tpu/plan/reuse.py): shared-prefix
+reuse across runs and incremental recompute over appended corpora.
+
+The exactness contract under test: cached, incremental, and cold
+executions of the same pipeline over the same inputs produce identical
+results; volatile stages never publish; a corrupted or truncated cache
+entry (and an injected ``cache_read`` fault) degrades to recompute —
+never to wrong output; concurrent publishers of one key resolve to
+exactly one on-disk entry.  See docs/reuse.md.
+"""
+
+import json
+import operator
+import os
+import shutil
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from dampr_tpu import Dampr, faults, settings
+from dampr_tpu.plan import reuse
+
+
+@pytest.fixture
+def reuse_on(partitions8):
+    """Reuse enabled over an isolated cache dir + scratch root, adaptive
+    feedback pinned off so the second run keys identically to the first
+    (history-driven option changes legitimately shift the key)."""
+    old = (settings.reuse, settings.reuse_dir, settings.reuse_budget_bytes,
+           settings.scratch_root, settings.plan_adapt)
+    settings.reuse = "on"
+    settings.reuse_dir = tempfile.mkdtemp(prefix="dampr-reuse-cache-")
+    settings.scratch_root = tempfile.mkdtemp(prefix="dampr-reuse-scratch-")
+    settings.plan_adapt = False
+    yield settings.reuse_dir
+    shutil.rmtree(settings.reuse_dir, ignore_errors=True)
+    shutil.rmtree(settings.scratch_root, ignore_errors=True)
+    (settings.reuse, settings.reuse_dir, settings.reuse_budget_bytes,
+     settings.scratch_root, settings.plan_adapt) = old
+
+
+def _corpus(d, nfiles=3, lines=300, stamp="w"):
+    os.makedirs(d, exist_ok=True)
+    for i in range(nfiles):
+        with open(os.path.join(d, "f{}.txt".format(i)), "w") as f:
+            for j in range(lines):
+                f.write("{}{} alpha beta gamma\n".format(stamp, j % 11))
+
+
+def _wordcount(d, binop=operator.add):
+    return (Dampr.text(d)
+            .flat_map(lambda line: line.split())
+            .map(lambda w: (w, 1))
+            .fold_by(lambda kv: kv[0], value=lambda kv: kv[1],
+                     binop=binop))
+
+
+def _cold(build):
+    """Oracle: the same pipeline with the cache off entirely."""
+    old = settings.reuse
+    settings.reuse = "off"
+    try:
+        return sorted(build().run(name="reuse-cold-oracle").stream())
+    finally:
+        settings.reuse = old
+
+
+class TestIdenticalRerun:
+    def test_second_run_mounts_and_is_identical(self, reuse_on, tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        first = _wordcount(d).run(name="reuse-id")
+        r1 = sorted(first.stream())
+        ru1 = first.stats()["reuse"]
+        assert ru1["enabled"] and ru1["bytes_published"] > 0
+
+        second = _wordcount(d).run(name="reuse-id")
+        r2 = sorted(second.stream())
+        ru2 = second.stats()["reuse"]
+        assert r1 == r2
+        assert ru2["hits"] >= 1 and ru2["stages_skipped"] >= 1
+        kinds = [s["kind"] for s in second.stats]
+        assert any(k.startswith("reused-") for k in kinds)
+        assert r1 == _cold(lambda: _wordcount(d))
+
+    def test_reuse_off_env_produces_identical_bytes(self, reuse_on,
+                                                    tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        r_on = sorted(_wordcount(d).run(name="reuse-on-leg").stream())
+        settings.reuse = "off"
+        off = _wordcount(d).run(name="reuse-off-leg")
+        assert sorted(off.stream()) == r_on
+        assert "reuse" not in off.stats()
+
+    def test_volatile_stage_never_cached(self, reuse_on, tmp_path):
+        class Opaque:
+            __slots__ = ()
+
+            def __reduce__(self):
+                raise TypeError("nope")
+
+            def __call__(self, x):
+                return (x % 3, 1)
+
+        def build():
+            return (Dampr.memory(list(range(30)), partitions=4)
+                    .map(Opaque())
+                    .fold_by(lambda kv: kv[0], value=lambda kv: kv[1],
+                             binop=operator.add))
+
+        got1 = dict(build().run(name="reuse-volatile").stream())
+        second = build().run(name="reuse-volatile")
+        got2 = dict(second.stream())
+        assert got1 == got2 == {0: 10, 1: 10, 2: 10}
+        ru = second.stats()["reuse"]
+        assert ru["hits"] == 0
+        assert any(d["decision"] == "volatile" for d in ru["decisions"])
+        # Nothing from the volatile chain may have landed on disk.
+        entries = os.path.join(reuse_on, "entries")
+        assert not os.path.isdir(entries) or not os.listdir(entries)
+
+
+class TestDegrade:
+    def _seed(self, tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        out = _wordcount(d).run(name="reuse-degrade")
+        return d, sorted(out.stream())
+
+    def _entry_dirs(self, cache_root):
+        ed = os.path.join(cache_root, "entries")
+        return [os.path.join(ed, n) for n in sorted(os.listdir(ed))
+                if not n.startswith(".tmp-")]
+
+    def test_corrupt_manifest_recomputes(self, reuse_on, tmp_path):
+        d, r1 = self._seed(tmp_path)
+        for e in self._entry_dirs(reuse_on):
+            with open(os.path.join(e, "manifest.json"), "w") as f:
+                f.write("{ not json !!")
+        out = _wordcount(d).run(name="reuse-degrade")
+        ru = out.stats()["reuse"]
+        assert sorted(out.stream()) == r1
+        assert ru["recompute_fallbacks"] >= 1 and ru["stages_skipped"] == 0
+
+    def test_truncated_block_recomputes(self, reuse_on, tmp_path):
+        d, r1 = self._seed(tmp_path)
+        truncated = 0
+        for e in self._entry_dirs(reuse_on):
+            for fn in os.listdir(e):
+                if fn.endswith(".frames"):
+                    p = os.path.join(e, fn)
+                    with open(p, "r+b") as f:
+                        f.truncate(max(0, os.path.getsize(p) // 2))
+                    truncated += 1
+        assert truncated
+        out = _wordcount(d).run(name="reuse-degrade")
+        ru = out.stats()["reuse"]
+        assert sorted(out.stream()) == r1
+        assert ru["recompute_fallbacks"] >= 1
+
+    def test_cache_read_fault_site_degrades(self, reuse_on, tmp_path):
+        d, r1 = self._seed(tmp_path)
+        faults.install(faults.FaultPlan("cache_read:p=1.0"))
+        try:
+            out = _wordcount(d).run(name="reuse-degrade")
+            ru = out.stats()["reuse"]
+            assert sorted(out.stream()) == r1
+            assert ru["recompute_fallbacks"] >= 1
+            # Chaos runs consume but never seed the shared cache.
+            assert ru["bytes_published"] == 0
+        finally:
+            faults.clear()
+
+
+class TestEviction:
+    def test_tight_budget_evicts_lru_whole_entries(self, reuse_on,
+                                                   tmp_path):
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        _corpus(d1, stamp="aa")
+        _corpus(d2, stamp="bb")
+        first = _wordcount(d1).run(name="reuse-evict")
+        published = first.stats()["reuse"]["bytes_published"]
+        assert published > 0
+        # Room for roughly one run's worth of entries, not two.
+        settings.reuse_budget_bytes = int(published * 1.25)
+        second = _wordcount(d2).run(name="reuse-evict")
+        ru = second.stats()["reuse"]
+        assert ru["evictions"] >= 1
+        store = reuse.CacheStore()
+        assert store.total_bytes() <= settings.reuse_budget_bytes
+        # Evicted prefix for d1 is gone -> a d1 rerun recomputes, exactly.
+        r1 = sorted(_wordcount(d1).run(name="reuse-evict").stream())
+        assert r1 == sorted(first.stream())
+
+    def test_single_entry_over_budget_is_declined(self, reuse_on,
+                                                  tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        settings.reuse_budget_bytes = 64  # smaller than any real entry
+        out = _wordcount(d).run(name="reuse-declined")
+        assert out.stats()["reuse"]["bytes_published"] == 0
+        entries = os.path.join(reuse_on, "entries")
+        names = (os.listdir(entries) if os.path.isdir(entries) else [])
+        assert not [n for n in names if not n.startswith(".tmp-")]
+
+
+class TestIncremental:
+    def test_append_only_growth_merges_partials(self, reuse_on, tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d, nfiles=3)
+        _wordcount(d).run(name="reuse-incr")
+        with open(os.path.join(d, "f3.txt"), "w") as f:
+            for j in range(80):
+                f.write("new{} appended tokens\n".format(j % 5))
+        out = _wordcount(d).run(name="reuse-incr")
+        ru = out.stats()["reuse"]
+        assert ru["incremental_merges"] >= 1
+        assert any(d_["decision"].startswith("incremental:")
+                   for d_ in ru["decisions"])
+        assert sorted(out.stream()) == _cold(lambda: _wordcount(d))
+
+    def test_grown_file_forces_full_recompute(self, reuse_on, tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        _wordcount(d).run(name="reuse-grown")
+        with open(os.path.join(d, "f0.txt"), "a") as f:
+            f.write("tail grew beyond the signed chunks\n")
+        out = _wordcount(d).run(name="reuse-grown")
+        ru = out.stats()["reuse"]
+        assert ru["incremental_merges"] == 0
+        assert sorted(out.stream()) == _cold(lambda: _wordcount(d))
+
+    def test_uncertified_fold_is_ineligible(self, reuse_on, tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        binop = lambda a, b: a + b  # noqa: E731 — no assoc certificate
+        _wordcount(d, binop).run(name="reuse-lam")
+        with open(os.path.join(d, "f3.txt"), "w") as f:
+            for j in range(50):
+                f.write("more{} appended tokens\n".format(j % 5))
+        out = _wordcount(d, binop).run(name="reuse-lam")
+        ru = out.stats()["reuse"]
+        assert ru["incremental_merges"] == 0
+        assert any(x["decision"].startswith("incremental-ineligible")
+                   for x in ru["decisions"])
+        assert sorted(out.stream()) == _cold(
+            lambda: _wordcount(d, binop))
+
+
+class TestConcurrentPublish:
+    def test_race_resolves_to_one_winner(self, reuse_on):
+        from dampr_tpu.blocks import Block
+        from dampr_tpu.runner import MTRunner
+        from dampr_tpu.storage import PartitionSet
+
+        runner = MTRunner("reuse-race", Dampr.memory([1]).pmer.graph)
+        try:
+            def mk_pset():
+                pset = PartitionSet(2)
+                blk = Block(np.arange(20, dtype=np.int64),
+                            np.arange(20, dtype=np.int64) * 3)
+                for pid, sub in blk.split_by_partition(2).items():
+                    pset.add(pid, runner.store.register(sub))
+                return pset
+
+            key = reuse._resume._h("race-key")
+            struct = reuse._resume._h("race-struct")
+            cache = reuse.CacheStore()
+            barrier = threading.Barrier(2)
+            landed = []
+
+            def publish():
+                pset = mk_pset()
+                barrier.wait()
+                landed.append(cache.publish(
+                    key, struct, pset, 20, None, runner.store))
+
+            ts = [threading.Thread(target=publish) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert any(b > 0 for b in landed)
+            entries = [n for n in os.listdir(
+                os.path.join(reuse_on, "entries"))
+                if not n.startswith(".tmp-")]
+            assert len(entries) == 1
+            m = cache.lookup(key)  # winner validates end-to-end
+            pset, nrec, _ = cache.mount(m, runner.store)
+            got = sorted(
+                (int(k), int(v))
+                for refs in pset.parts.values() for ref in refs
+                for k, v in ref.get().iter_pairs())
+            assert got == [(i, i * 3) for i in range(20)]
+        finally:
+            runner.store.cleanup()
+
+
+class TestSurfaces:
+    def test_stats_renderer_shows_reuse_section(self):
+        from dampr_tpu.obs import export
+
+        text = export.format_summary({
+            "run": "r", "wall_seconds": 1.0, "stages": [],
+            "reuse": {"enabled": True, "hits": 2, "misses": 1,
+                      "stages_skipped": 2, "bytes_mounted": 1024,
+                      "bytes_published": 0, "incremental_merges": 1,
+                      "recompute_fallbacks": 0, "evictions": 0,
+                      "decisions": [{"stage": 1, "decision": "hit"}]},
+        })
+        assert "reuse: 2 hit(s)" in text
+        assert "s1=hit" in text
+
+    def test_explain_has_reuse_preview(self, reuse_on, tmp_path):
+        d = str(tmp_path / "data")
+        _corpus(d)
+        _wordcount(d).run(name="reuse-explain")
+        text = _wordcount(d).explain()
+        assert "reuse: cache" in text
+        assert "would mount" in text
+
+    def test_explain_reuse_off_one_liner(self):
+        old = settings.reuse
+        settings.reuse = "off"
+        try:
+            text = Dampr.memory([1, 2, 3]).map(lambda x: x).explain()
+            assert "reuse: off" in text
+        finally:
+            settings.reuse = old
+
+    def test_trace_carries_reuse_spans(self, reuse_on, tmp_path):
+        old_tr, old_td = settings.trace, settings.trace_dir
+        settings.trace = True
+        settings.trace_dir = str(tmp_path / "traces")
+        try:
+            d = str(tmp_path / "data")
+            _corpus(d)
+            _wordcount(d).run(name="reuse-traced")
+            out = _wordcount(d).run(name="reuse-traced")
+            tf = out.stats().get("trace_file")
+            assert tf and os.path.isfile(tf)
+            with open(tf) as f:
+                cats = {e.get("cat") for e in
+                        json.load(f)["traceEvents"]}
+            assert "reuse" in cats
+        finally:
+            settings.trace, settings.trace_dir = old_tr, old_td
+
+    def test_doctor_thrash_finding(self, tmp_path):
+        from dampr_tpu.obs import doctor
+
+        stats = {
+            "schema": "dampr-tpu-stats/1", "run": "thrash-run",
+            "wall_seconds": 5.0, "stages": [],
+            "reuse": {"enabled": True, "hits": 0, "misses": 4,
+                      "evictions": 6, "bytes_published": 123456},
+        }
+        p = tmp_path / "stats.json"
+        with open(p, "w") as f:
+            json.dump(stats, f)
+        rep = doctor.diagnose(str(p))
+        f = [x for x in rep["findings"]
+             if x["bottleneck"] == "reuse-thrash"]
+        assert f, rep["findings"]
+        assert any(s["setting"] == "reuse_budget_bytes"
+                   for s in f[0]["suggestions"])
+        assert rep["reuse"]["evictions"] == 6
+
+    def test_doctor_playbook_reuse_knobs_exist(self):
+        from dampr_tpu.obs.doctor import _PLAYBOOK
+
+        for verdict in ("reuse-thrash", "reuse-off"):
+            assert verdict in _PLAYBOOK
+            for knob, _env, _fn, _why in _PLAYBOOK[verdict]:
+                assert hasattr(settings, knob), knob
