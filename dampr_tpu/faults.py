@@ -85,6 +85,7 @@ SITES = (
     "progress_tick",    # obs/progress.py loop
     "overlap_produce",  # runner._overlap_stream producer (race widener)
     "cache_read",       # plan/reuse.py manifest/block reads (degrade path)
+    "stream_publish",   # runner pipelined publish hook (streamed edges)
 )
 
 

@@ -165,8 +165,26 @@ class FrameReader(object):
         in place with backoff (``settings.io_retries``) — pread of an
         immutable published file is idempotent; format errors are
         deterministic and propagate immediately."""
-        return _faults.retry_io(lambda: self._read_frame_once(i),
-                                "spill_read")
+        return self._read_frame_timed(i)[0]
+
+    def _read_frame_timed(self, i):
+        """(payload, seconds) where seconds covers only the SUCCESSFUL
+        attempt — attempt-scoped like spill attribution.  Timing the
+        whole retry loop instead would fold failed attempts and their
+        backoff sleeps into the store's spill_read_seconds, corrupting
+        the throughput metric (mbps) every time a transient retry or a
+        prefetched re-read fires."""
+        cell = [0.0]
+
+        def attempt():
+            t0 = time.perf_counter()
+            try:
+                return self._read_frame_once(i)
+            finally:
+                cell[0] = time.perf_counter() - t0
+
+        payload = _faults.retry_io(attempt, "spill_read")
+        return payload, cell[0]
 
     def _read_frame_once(self, i):
         _faults.check("spill_read")
@@ -205,19 +223,17 @@ class FrameReader(object):
         n = len(self.index)
         if prefetch <= 0 or n <= 1:
             for i in range(n):
-                t0 = time.perf_counter()
-                payload = self.read_frame(i)
+                payload, secs = self._read_frame_timed(i)
                 if on_read is not None:
-                    on_read(self.index[i][3], time.perf_counter() - t0)
+                    on_read(self.index[i][3], secs)
                 yield payload
             return
 
         pool = read_executor()
 
         def task(i):
-            t0 = time.perf_counter()
-            payload = self.read_frame(i)
-            return payload, self.index[i][3], time.perf_counter() - t0
+            payload, secs = self._read_frame_timed(i)
+            return payload, self.index[i][3], secs
 
         pending = deque()
         nxt = 0

@@ -72,6 +72,11 @@ _RESOURCE_BY_CAT = {
     # are checkpoint-shaped work: durable materialization IO, never
     # productive compute — same tie-break tier as checkpoints.
     "reuse": "checkpoint",
+    # Streamed-edge folder/chain spans (runner._StreamFolder) are
+    # productive fold work hidden under the producing stage; the
+    # publish backpressure wait rides "stall" spans named stream-wait
+    # and classifies as pipeline-stall via _resource_of below.
+    "pipeline": "fold",
 }
 
 #: Verdicts that may be *covered* by other work happening concurrently:
@@ -79,7 +84,7 @@ _RESOURCE_BY_CAT = {
 #: it, so productive resources win ties at equal fractions.
 _PRIORITY = ("device", "codec", "fold", "merge", "mesh", "spill-write",
              "transfer", "spill-queue", "io-read", "overlap-stall",
-             "skew", "checkpoint", "host-compute")
+             "pipeline-stall", "skew", "checkpoint", "host-compute")
 
 _STAGE_NAME = re.compile(r"^s(\d+):")
 
@@ -87,6 +92,13 @@ _STAGE_NAME = re.compile(r"^s(\d+):")
 def _resource_of(cat, name):
     if cat == "io_wait":
         return "spill-queue" if "writer" in (name or "") else "io-read"
+    if cat == "stall":
+        # Streamed-edge publish backpressure ("stream-wait") is its own
+        # verdict — the doctor's fix (raise pipeline_queue_bytes) is
+        # different from the overlap executor's stall knobs, whose
+        # "pipe-wait" spans stay overlap-stall.
+        return ("pipeline-stall" if "stream" in (name or "")
+                else "overlap-stall")
     if cat == "device":
         # Both the dispatch ("map-fold") and the drain span are device
         # time: dispatch is async, so the program's COMPUTE surfaces

@@ -61,6 +61,17 @@ _PLAYBOOK = {
          "every live fold consumer was blocked on its codec producer — "
          "deeper overlap windows keep folds fed"),
     ],
+    "pipeline-stall": [
+        ("pipeline_queue_bytes", "DAMPR_TPU_PIPELINE_QUEUE",
+         lambda cur: None,
+         "streamed-edge publishes blocked on the folder's backpressure "
+         "bound (default budget/4) — a larger queue lets map jobs run "
+         "further ahead of the early-fold consumer"),
+        ("pipeline", "DAMPR_TPU_PIPELINE",
+         lambda cur: "0",
+         "if the stalls outweigh the overlap the edge buys, the kill "
+         "switch restores fully staged execution byte-identically"),
+    ],
     "codec": [
         ("lower", "DAMPR_TPU_LOWER",
          lambda cur: "1",

@@ -97,7 +97,8 @@ _KNOBS = ("analyze", "partitions", "batch_size", "max_memory_per_stage",
           "mitigate", "speculate_threshold", "speculate_after_steps",
           "mitigate_probe_windows", "exchange_coding", "cost_model",
           "autotune", "autotune_trials", "handoff", "reuse",
-          "reuse_budget_bytes")
+          "reuse_budget_bytes", "pipeline", "pipeline_queue_bytes",
+          "exchange_codec")
 
 
 def corpus_path(run_name):

@@ -45,16 +45,43 @@ import os
 import pickle
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 from .. import faults as _faults
 from .. import settings
+from ..io import codecs as _codecs
 from . import mitigate as _mitigate
 from . import replan
 from .mesh import mesh_size, shard_map as _shard_map
 
 log = logging.getLogger("dampr_tpu.parallel.exchange")
+
+
+def wire_codec():
+    """The per-route payload codec (``settings.exchange_codec``), or None
+    for uncompressed wire bytes.  ``auto`` resolves down a zstd -> lz4 ->
+    OFF ladder — unlike the spill codec, the exchange never falls back to
+    zlib: on an in-memory wire path a slow stdlib DEFLATE costs more
+    step latency than the bytes it saves, while the spill path is
+    amortized against disk.  Every blob carries a one-byte codec id, so
+    a blob whose compressed form isn't smaller ships raw under the same
+    framing."""
+    name = str(settings.exchange_codec).lower()
+    if name in ("off", "0", "false", "no", "none", "raw"):
+        return None
+    if name == "auto":
+        for cand in ("zstd", "lz4"):
+            if _codecs.available(cand):
+                return _codecs.resolve(cand)
+        return None
+    try:
+        codec = _codecs.resolve(name)
+    except ValueError:
+        log.warning("unknown exchange_codec %r; sending raw", name)
+        return None
+    return None if codec.cid == _codecs.RAW else codec
 
 
 @functools.lru_cache(maxsize=None)
@@ -192,6 +219,34 @@ def mesh_blob_exchange(mesh, blobs, budget=None, coding=None):
         }
         return dict(blobs)
     gather = jax.process_count() > 1
+    # Per-route wire compression (settings.exchange_codec): blobs
+    # compress BEFORE planning, so the schedule's cells slice WIRE bytes
+    # and every downstream byte count (sent/received/pair/steps) is what
+    # actually crossed the collective.  One-byte codec id per blob;
+    # blobs that don't shrink ship raw under the same framing; empty
+    # blobs stay empty (they deliver nothing, coded or not).
+    codec = wire_codec()
+    codec_info = None
+    if codec is not None and blobs:
+        raw_total = wire_total = 0
+        wire = {}
+        for sd, b in blobs.items():
+            if not b:
+                wire[sd] = b
+                continue
+            cb = codec.compress(b)
+            if len(cb) + 1 < len(b):
+                wire[sd] = bytes((codec.cid,)) + cb
+            else:
+                wire[sd] = bytes((_codecs.RAW,)) + b
+            raw_total += len(b)
+            wire_total += len(wire[sd])
+        blobs = wire
+        codec_info = {"name": codec.name, "raw_bytes": raw_total,
+                      "wire_bytes": wire_total}
+        global codec_raw_bytes, codec_wire_bytes
+        codec_raw_bytes += raw_total
+        codec_wire_bytes += wire_total
     sched = replan.plan_exchange(
         D, {sd: len(b) for sd, b in blobs.items()},
         budget=budget, gather=gather, coding=coding)
@@ -204,61 +259,107 @@ def mesh_blob_exchange(mesh, blobs, budget=None, coding=None):
             pair[(s, d)] = pair.get((s, d), 0) + n
     parts = {}
     entry_perf = None
-    for i, step in enumerate(sched.steps):
+
+    def pack_step(step):
+        """Host-side staging of one step's send buffer.  Pure over
+        (blobs, step) — safe to run one step ahead on the packer thread
+        while the current step's collective is in flight."""
+        t0 = time.perf_counter()
         buf = np.zeros((D * D, step.capacity), dtype=np.uint8)
         lens = np.zeros(D * D, dtype=np.int32)
-        with _trace.span("exchange", "h2d:{}".format(i),
-                         step=i, capacity=int(step.capacity)):
-            for s, d, start, stop in step.cells:
-                row = s * D + d
-                n = stop - start
-                lens[row] = n
-                if n:
-                    buf[row, :n] = np.frombuffer(
-                        blobs[(s, d)], dtype=np.uint8, count=n,
-                        offset=start)
-                    sent[s] += n
-        prog = _build_exchange(mesh, settings.mesh_axis, step.capacity,
-                               gather=gather)
-        # Fault sites: ``rank_kill`` (exit action — the multi-process
-        # chaos tests kill one rank mid-exchange here, precisely where a
-        # real dead rank would leave its peers hanging) and
-        # ``exchange_step`` (classified failures on the step itself).
-        _faults.check("rank_kill")
-        _faults.check("exchange_step")
-        if i == 0:
-            # First-step collective entry on this rank's monotonic clock
-            # — AFTER the fault checks, so an injected slow stretch
-            # (sleep_ms) shows up as entry lateness exactly like real
-            # host-side straggling would.  Shared fleet-wide below.
-            entry_perf = time.perf_counter()
-        timeout_ms = settings.exchange_timeout_ms
-        guard = None
-        if timeout_ms > 0:
-            global watchdogs_armed
-            watchdogs_armed += 1
-            guard = _step_watchdog(i, timeout_ms)
-        try:
-            with _trace.span("exchange", "step:{}".format(i), step=i,
-                             bytes=int(step.payload_bytes()),
-                             capacity=int(step.capacity),
-                             inflight_bytes=int(step.inflight_bytes)):
-                rb, rl = prog(buf, lens)
-                rb.block_until_ready()
-        finally:
-            if guard is not None:
-                guard.set()
-        with _trace.span("exchange", "d2h:{}".format(i), step=i):
-            rb = np.asarray(rb)
-            rl = np.asarray(rl)
-            for s, d, _start, _stop in step.cells:
-                row = d * D + s  # device d's local row s = sent by s
-                n = int(rl[row])
-                if n:
-                    parts.setdefault((s, d), []).append(
-                        rb[row, :n].tobytes())
-                    received[d] += n
+        sent_inc = [0] * D
+        for s, d, start, stop in step.cells:
+            row = s * D + d
+            n = stop - start
+            lens[row] = n
+            if n:
+                buf[row, :n] = np.frombuffer(
+                    blobs[(s, d)], dtype=np.uint8, count=n,
+                    offset=start)
+                sent_inc[s] += n
+        pack_acct["seconds"] += time.perf_counter() - t0
+        return buf, lens, sent_inc
+
+    # Double-buffered schedule execution (settings.pipeline,
+    # docs/pipeline.md): step k+1's h2d staging packs on a background
+    # thread while step k's collective runs, so the host-side copy cost
+    # hides behind device time.  The watchdog and fault sites stay
+    # strictly per step on the dispatching thread — only the pure pack
+    # moved off it.  DAMPR_TPU_PIPELINE=0 restores the serial loop.
+    pack_acct = {"seconds": 0.0, "exposed": 0.0}
+    packer = None
+    if settings.pipeline_enabled() and len(sched.steps) > 1:
+        packer = ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="dampr-tpu-xpack")
+    try:
+        nxt = (packer.submit(pack_step, sched.steps[0])
+               if packer is not None and sched.steps else None)
+        for i, step in enumerate(sched.steps):
+            with _trace.span("exchange", "h2d:{}".format(i),
+                             step=i, capacity=int(step.capacity)):
+                if packer is not None:
+                    wait0 = time.perf_counter()
+                    buf, lens, sent_inc = nxt.result()
+                    pack_acct["exposed"] += time.perf_counter() - wait0
+                    if i + 1 < len(sched.steps):
+                        nxt = packer.submit(pack_step, sched.steps[i + 1])
+                else:
+                    s0 = pack_acct["seconds"]
+                    buf, lens, sent_inc = pack_step(step)
+                    pack_acct["exposed"] += pack_acct["seconds"] - s0
+            for s in range(D):
+                sent[s] += sent_inc[s]
+            prog = _build_exchange(mesh, settings.mesh_axis, step.capacity,
+                                   gather=gather)
+            # Fault sites: ``rank_kill`` (exit action — the multi-process
+            # chaos tests kill one rank mid-exchange here, precisely where
+            # a real dead rank would leave its peers hanging) and
+            # ``exchange_step`` (classified failures on the step itself).
+            _faults.check("rank_kill")
+            _faults.check("exchange_step")
+            if i == 0:
+                # First-step collective entry on this rank's monotonic
+                # clock — AFTER the fault checks, so an injected slow
+                # stretch (sleep_ms) shows up as entry lateness exactly
+                # like real host-side straggling would.  Shared below.
+                entry_perf = time.perf_counter()
+            timeout_ms = settings.exchange_timeout_ms
+            guard = None
+            if timeout_ms > 0:
+                global watchdogs_armed
+                watchdogs_armed += 1
+                guard = _step_watchdog(i, timeout_ms)
+            try:
+                with _trace.span("exchange", "step:{}".format(i), step=i,
+                                 bytes=int(step.payload_bytes()),
+                                 capacity=int(step.capacity),
+                                 inflight_bytes=int(step.inflight_bytes)):
+                    rb, rl = prog(buf, lens)
+                    rb.block_until_ready()
+            finally:
+                if guard is not None:
+                    guard.set()
+            with _trace.span("exchange", "d2h:{}".format(i), step=i):
+                rb = np.asarray(rb)
+                rl = np.asarray(rl)
+                for s, d, _start, _stop in step.cells:
+                    row = d * D + s  # device d's local row s = sent by s
+                    n = int(rl[row])
+                    if n:
+                        parts.setdefault((s, d), []).append(
+                            rb[row, :n].tobytes())
+                        received[d] += n
+    finally:
+        if packer is not None:
+            packer.shutdown(wait=True)
+    hidden = max(0.0, pack_acct["seconds"] - pack_acct["exposed"])
+    global pack_seconds_total, pack_hidden_seconds_total
+    pack_seconds_total += pack_acct["seconds"]
+    pack_hidden_seconds_total += hidden
     out = {sd: b"".join(ps) for sd, ps in parts.items()}
+    if codec is not None:
+        out = {sd: _codecs.decompress(b[0], b[1:])
+               for sd, b in out.items()}
     if ctl is not None and gather and entry_perf is not None:
         # Live skew observation: one tiny all_gather of (entry time,
         # transient-fault count) per rank — every rank receives the SAME
@@ -310,7 +411,19 @@ def mesh_blob_exchange(mesh, blobs, budget=None, coding=None):
         # exchange — obs.fleet folds device routes into the rank-level
         # send/recv matrix the straggler diagnosis reads.
         "pair_bytes": pair,
+        # Double-buffer evidence: host pack seconds, the share of them
+        # hidden behind the previous step's collective, and whether the
+        # overlapped executor ran at all (>1 step + pipeline on).
+        "overlap": {
+            "pack_seconds": round(pack_acct["seconds"], 6),
+            "hidden_seconds": round(hidden, 6),
+            "hidden_fraction": (round(hidden / pack_acct["seconds"], 4)
+                                if pack_acct["seconds"] > 1e-9 else 0.0),
+            "pipelined": packer is not None,
+        },
     }
+    if codec_info is not None:
+        last_info["codec"] = codec_info
     if sched.coding:
         last_info["coding"] = dict(sched.coding)
     return out
@@ -419,6 +532,14 @@ received_bytes_per_device = {}
 #: snapshots per-run deltas into ``stats()["mesh"]["exchange"]`` and
 #: obs.fleet aggregates routes into the rank x rank matrix.
 pair_bytes_per_route = {}
+#: Cumulative per-route codec accounting (settings.exchange_codec):
+#: pre-compression payload bytes vs what actually crossed the wire.
+codec_raw_bytes = 0
+codec_wire_bytes = 0
+#: Cumulative double-buffer accounting: host pack seconds across every
+#: schedule, and the share that hid behind an in-flight collective.
+pack_seconds_total = 0.0
+pack_hidden_seconds_total = 0.0
 
 
 def mesh_shuffle_blocks(mesh, routed, coding=None):

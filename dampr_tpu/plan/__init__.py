@@ -40,13 +40,13 @@ that fired.  See ``docs/plan.md``.
 import time
 
 from .. import settings
-from . import cost, explain, ir, lower, passes
+from . import cost, explain, ir, lower, passes, pipeline
 from .explain import explain_text
 from .ir import graph_signature
 from .passes import optimize
 
 __all__ = ["optimize", "apply_to_runner", "explain_text", "graph_signature",
-           "ir", "passes", "cost", "explain", "lower"]
+           "ir", "passes", "cost", "explain", "lower", "pipeline"]
 
 
 def empty_report(graph, enabled):
@@ -108,6 +108,12 @@ def apply_to_runner(runner, outputs):
     # win, auto decides from the history corpus) the runner's dispatch
     # consults when it exchanges partitions.
     lower.apply_shuffle(runner, report)
+    # Streamed-edge analysis (plan/pipeline.py): which stage barriers the
+    # pipelined executor may dissolve, decided over the stage list that
+    # will EXECUTE (after fusion/lowering/shuffle routing, on both
+    # optimizer legs).  Decisions land in report["pipeline"] always;
+    # runner dispatch hints only when settings.pipeline is on.
+    pipeline.apply(runner, outputs, report)
     # Static analysis (dampr_tpu.analyze, settings.analyze): per-stage
     # purity/determinism verdicts + coded diagnostics over the stage
     # list that will EXECUTE, recorded in the report's "analysis"

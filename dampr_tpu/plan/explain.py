@@ -9,7 +9,7 @@ graph (``optimize`` is value-semantic).
 
 from .. import settings
 from ..graph import GInput
-from . import cost, ir, lower, passes
+from . import cost, ir, lower, passes, pipeline as _pipeline
 
 
 def _stage_lines(graph, indent="  "):
@@ -38,6 +38,7 @@ def explain_text(graph, outputs, name=None):
                      "DAMPR_TPU_OPTIMIZE=0): the plan above executes as-is")
         lines.extend(_target_lines(graph, name, outputs))
         lines.extend(_shuffle_lines(graph, name, outputs))
+        lines.extend(_pipeline_lines(graph, outputs))
         lines.extend(_analysis_lines(graph))
         lines.extend(_reuse_lines(graph))
         return "\n".join(lines)
@@ -84,9 +85,31 @@ def explain_text(graph, outputs, name=None):
     lines.extend(_cost_lines(optimized, name))
     lines.extend(_target_lines(optimized, name, outputs))
     lines.extend(_shuffle_lines(optimized, name, outputs))
+    lines.extend(_pipeline_lines(optimized, outputs))
     lines.extend(_analysis_lines(optimized))
     lines.extend(_reuse_lines(optimized))
     return "\n".join(lines)
+
+
+def _pipeline_lines(graph, outputs=()):
+    """The streamed-edge decision table (plan/pipeline.py): which stage
+    barriers the pipelined executor dissolves and why the rest stay."""
+    decisions = _pipeline.analyze(graph, outputs)
+    if not decisions:
+        return []
+    n_str = sum(1 for d in decisions if d["decision"] == "streamed")
+    state = ("on" if settings.pipeline_enabled()
+             else "OFF (settings.pipeline / DAMPR_TPU_PIPELINE=0 — "
+                  "staged execution)")
+    lines = ["pipeline: {} of {} stage edge(s) streamed — {}".format(
+        n_str, len(decisions), state)]
+    for d in decisions:
+        dst = "s{}".format(d["dst"]) if d["dst"] is not None else "read"
+        what = ("streamed[{}]".format(d["mode"])
+                if d["decision"] == "streamed" else "barrier")
+        lines.append("  s{} -> {}: {}  ({})".format(
+            d["src"], dst, what, d["reason"]))
+    return lines
 
 
 def _analysis_lines(graph):

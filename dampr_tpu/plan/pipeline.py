@@ -1,0 +1,287 @@
+"""Streamed-edge analysis: which producer->consumer stage edges may run
+barrier-free (docs/pipeline.md).
+
+The staged executor materializes every stage's output fully before its
+consumer starts a single job.  This pass walks the stage list that will
+EXECUTE (it runs after optimize/lower/shuffle on both optimizer legs) and
+marks each producer->consumer edge either ``streamed`` — the runner may
+dissolve the barrier — or ``barrier``, with the reason recorded either
+way.  Three streamed shapes exist, each chosen only where the pipelined
+result is provably byte-identical to staged execution:
+
+- ``early_fold`` (map -> keyed fold): completed map partitions publish
+  into a bounded queue and a folder thread pre-folds them under the
+  consuming reduce's associative op while the map stage is still
+  running.  Safe because both reduce paths emit in ascending real-key
+  order after an exact hash-grouped fold, so for commutative ops
+  (integer/bool sums; min/max over numeric lanes — the runtime gates
+  per-block dtypes, the same exactness rule the coded exchange uses)
+  regrouping partials cannot change a single output byte.
+- ``chain`` (map -> map the optimizer didn't fuse): consumer jobs run
+  per completed producer partition block, collected back in the staged
+  job order so every downstream flat record stream sees the identical
+  sequence.  Requires hash fan-out on both sides (sorted-run
+  materialization stays a barrier) and a downstream free of
+  boundary-sensitive consumers (reduces, sinks).
+- ``merge_stream`` (spill-merge generations -> final read): the
+  sorted-run final read already streams a k-way merge straight from the
+  run files; the pass records the edge so the decision table is total.
+
+Everything else keeps the barrier: explicit checkpoints and ``cached()``
+pins, sort materialization, multi-consumer outputs, sinks, resume
+checkpointing, device lowering/handoff, and mesh-routed exchanges.
+Decisions are computed even when ``settings.pipeline`` is off (the
+report's ``active`` flag records the kill switch) so ``explain()``
+always shows the table.
+
+The runner consumes the decisions as ``runner._pipeline_edges`` — a
+runtime dispatch hint keyed by producer sid, deliberately NOT stage
+options, so resume fingerprints stay history-independent (the
+``_handoff_sids`` precedent).
+"""
+
+from .. import base, settings
+from ..graph import GInput, GMap, GReduce, GSink
+
+#: Associative-op kinds whose cross-partial regrouping is byte-exact
+#: (commutative under the runtime dtype gate — see runner._StreamFolder).
+SAFE_FOLD_KINDS = ("sum", "min", "max")
+
+
+def _consumers(graph):
+    by_output = {}
+    for sid, stage in enumerate(graph.stages):
+        for src in stage.inputs:
+            by_output.setdefault(src, []).append(sid)
+    return by_output
+
+
+def _is_barrier_stage(stage):
+    """Explicit checkpoint / cached() pin: the user asked for a durable
+    materialization boundary here."""
+    return bool(stage.options.get("barrier") or stage.options.get("memory"))
+
+
+def _feeds_boundary_sensitive(graph, output, consumers, requested, seen=None):
+    """True when ``output`` transitively reaches a reduce or sink through
+    map stages.  Chain streaming changes block boundaries (never record
+    sequences); reduces' streamed merges and sinks' part files observe
+    boundaries, so any such reachable consumer keeps the barrier."""
+    if seen is None:
+        seen = set()
+    if output in seen:
+        return False
+    seen.add(output)
+    for sid in consumers.get(output, ()):
+        stage = graph.stages[sid]
+        if isinstance(stage, (GReduce, GSink)):
+            return True
+        if isinstance(stage, GMap) and _feeds_boundary_sensitive(
+                graph, stage.output, consumers, requested, seen):
+            return True
+    return False
+
+
+def _mesh_possible():
+    """Could a mesh fold/exchange engage this run?  Conservative: any
+    multi-device auto resolution (or forced-on mesh knob) bars streaming
+    — the mesh paths have their own windowed overlap and their exactness
+    story must not depend on pre-folded inputs."""
+    if not settings.use_device:
+        return False
+    fold = str(settings.mesh_fold).lower()
+    exch = str(settings.mesh_exchange).lower()
+    if fold in ("on", "1", "true") or exch in ("on", "1", "true"):
+        return True
+    if fold in ("off", "0", "false") and exch in ("off", "0", "false"):
+        return False
+    try:
+        return settings.device_count_for_auto() > 1
+    except Exception:  # noqa: BLE001 - device probe never fails planning
+        return True
+
+
+def analyze(graph, outputs, runner=None):
+    """One decision record per producer->consumer edge (plus the
+    sorted-run final-read edges): ``{src, dst, output, decision, mode,
+    reason}``.  ``dst`` is None for final-read edges.  Pure analysis —
+    never mutates the graph or the runner."""
+    consumers = _consumers(graph)
+    requested = set(outputs or ())
+    decisions = []
+    resume_active = runner is not None and bool(getattr(runner, "resume",
+                                                        False))
+    handoff_sids = (getattr(runner, "_handoff_sids", None) or set()
+                    if runner is not None else set())
+    # Only mesh-routed redistribution bars streaming: _shuffle_targets
+    # records a {sid: "mesh"|"host"} decision for EVERY redistribution
+    # stage, and host routing is the ordinary staged read path.
+    shuffle_sids = set(
+        sid for sid, tgt in
+        (getattr(runner, "_shuffle_targets", None) or {}).items()
+        if tgt == "mesh") if runner is not None else set()
+    mesh = _mesh_possible()
+
+    for sid, stage in enumerate(graph.stages):
+        if isinstance(stage, (GInput, GSink)):
+            continue
+        out = stage.output
+        pin = bool(stage.options.get("memory"))
+        has_combiner = (stage.combiner is not None
+                        or "binop" in stage.options) \
+            if isinstance(stage, GMap) else False
+        sinks = consumers.get(out, [])
+        sorted_run = (isinstance(stage, GMap)
+                      and settings.sort_runs_enabled()
+                      and not has_combiner and not pin
+                      and not any(isinstance(graph.stages[c], GReduce)
+                                  for c in sinks))
+
+        def edge(dst, decision, mode, reason):
+            decisions.append({
+                "src": sid, "dst": dst, "output": getattr(out, "sid", out),
+                "decision": decision, "mode": mode, "reason": reason})
+
+        if not sinks:
+            # Final-read edge: a requested output with no stage consumer.
+            if out in requested and sorted_run:
+                edge(None, "streamed", "merge_stream",
+                     "spill-merge generations stream into the final "
+                     "k-way merge read")
+            elif out in requested:
+                edge(None, "barrier", None,
+                     "requested output materializes")
+            continue
+
+        for dst in sinks:
+            cons = graph.stages[dst]
+            if not isinstance(stage, GMap):
+                edge(dst, "barrier", None, "non-map producer")
+                continue
+            if _is_barrier_stage(stage) or _is_barrier_stage(cons):
+                edge(dst, "barrier", None,
+                     "explicit checkpoint/cached materialization")
+                continue
+            if len(sinks) > 1:
+                edge(dst, "barrier", None, "multi-consumer output")
+                continue
+            if out in requested:
+                edge(dst, "barrier", None,
+                     "requested output materializes")
+                continue
+            if resume_active:
+                edge(dst, "barrier", None,
+                     "resume checkpointing persists stage boundaries")
+                continue
+            if settings.reuse_enabled():
+                edge(dst, "barrier", None,
+                     "reuse cache may publish this edge")
+                continue
+            if (stage.options.get("exec_target") == "device"
+                    or cons.options.get("exec_target") == "device"):
+                edge(dst, "barrier", None, "device-lowered stage")
+                continue
+            if sid in handoff_sids or dst in handoff_sids:
+                edge(dst, "barrier", None, "device handoff edge")
+                continue
+            if sid in shuffle_sids or dst in shuffle_sids:
+                edge(dst, "barrier", None, "mesh-routed exchange")
+                continue
+            if mesh:
+                edge(dst, "barrier", None,
+                     "mesh fold/exchange may engage")
+                continue
+
+            if isinstance(cons, GReduce):
+                if len(cons.inputs) != 1:
+                    edge(dst, "barrier", None, "multi-input reduce (join)")
+                elif pin:
+                    edge(dst, "barrier", None, "memory-pinned producer")
+                elif (isinstance(cons.reducer, base.AssocFoldReducer)
+                      and getattr(cons.reducer.op, "kind", None)
+                      in SAFE_FOLD_KINDS):
+                    edge(dst, "streamed", "early_fold",
+                         "associative {} fold: partials pre-fold during "
+                         "the map stage (runtime gates per-block dtypes)"
+                         .format(cons.reducer.op.kind))
+                else:
+                    edge(dst, "barrier", None,
+                         "order-sensitive reduce (no commutative "
+                         "associative op)")
+                continue
+
+            if isinstance(cons, GSink):
+                edge(dst, "barrier", None, "sink part files materialize")
+                continue
+
+            # map -> map chain.
+            if sorted_run:
+                edge(dst, "barrier", None,
+                     "sorted-run materialization (spill-lean external "
+                     "sort)")
+                continue
+            if has_combiner:
+                edge(dst, "barrier", None,
+                     "producer compaction may re-fold partials")
+                continue
+            if len(cons.inputs) != 1:
+                edge(dst, "barrier", None, "multi-input consumer (join)")
+                continue
+            if (cons.combiner is not None or "binop" in cons.options):
+                edge(dst, "barrier", None, "consumer carries a combiner")
+                continue
+            if not base.is_pure_record_stream(cons.mapper):
+                edge(dst, "barrier", None,
+                     "consumer is not a pure record stream")
+                continue
+            if (settings.sort_runs_enabled()
+                    and not bool(cons.options.get("memory"))):
+                edge(dst, "barrier", None,
+                     "sorted-run materialization (consumer side)")
+                continue
+            if _feeds_boundary_sensitive(graph, cons.output, consumers,
+                                         requested):
+                edge(dst, "barrier", None,
+                     "downstream reduce/sink observes block boundaries")
+                continue
+            edge(dst, "streamed", "chain",
+                 "pure record chain: consumer jobs run per completed "
+                 "producer block, collected in staged order")
+    return decisions
+
+
+def empty_section(active):
+    return {"active": bool(active), "edges": [], "streamed": 0,
+            "barriers": 0}
+
+
+def apply(runner, outputs, report):
+    """Attach the edge decisions to the report and the runner.  The
+    runner hint maps producer sid -> its streamed edge (one at most —
+    multi-consumer outputs stay barriers)."""
+    graph = getattr(runner, "graph", None)
+    active = settings.pipeline_enabled()
+    if graph is None or not hasattr(graph, "stages"):
+        report["pipeline"] = empty_section(active)
+        return
+    try:
+        decisions = analyze(graph, outputs, runner=runner)
+    except Exception:  # noqa: BLE001 - planning analysis never fails a run
+        report["pipeline"] = empty_section(active)
+        return
+    streamed = [d for d in decisions if d["decision"] == "streamed"]
+    report["pipeline"] = {
+        "active": active,
+        "edges": decisions,
+        "streamed": len(streamed),
+        "barriers": len(decisions) - len(streamed),
+    }
+    hints = {}
+    if active:
+        for d in streamed:
+            if d["mode"] in ("early_fold", "chain") and d["dst"] is not None:
+                hints[d["src"]] = {"mode": d["mode"], "dst": d["dst"]}
+    try:
+        runner._pipeline_edges = hints
+    except AttributeError:
+        pass

@@ -304,6 +304,244 @@ def _overlap_stream(items, store, size_of=None):
     return gen()
 
 
+class _FoldDeclined(Exception):
+    """Internal _StreamFolder control flow: this mapping keeps its
+    original refs (ineligible dtype or mid-drain disable)."""
+
+
+class _StreamFolder(object):
+    """Consumer half of a streamed map->keyed-fold edge (docs/pipeline.md):
+    completed map-job partition mappings publish into a bounded queue and
+    a folder thread pre-folds each one under the consuming reduce's
+    associative op while the map stage is still running, so the reduce
+    inherits compacted partials and the fold work hides under map compute.
+
+    Byte-identity contract: folding only regroups partials across jobs —
+    both reduce paths fold the exact hash groups and emit in ascending
+    real-key order, so for commutative ops the regrouping cannot change a
+    single output byte.  Commutativity is gated per block at run time
+    (the coded-exchange exactness rule): ``sum`` folds integer/bool value
+    lanes only (reordered float addition is not byte-identical), min/max
+    fold any numeric lane.  The first ineligible block disables folding
+    for the stage — remaining mappings pass through untouched, which is
+    always correct.
+
+    Backpressure: ``publish`` runs on the dispatching thread AFTER the
+    job's result committed (attempt rollback and speculation already
+    resolved) and blocks while queued bytes exceed ``bound``.  Queued
+    bytes are charged through ``store.reserve_overlap`` so spill
+    admission sees the pressure; the charge releases as each mapping
+    folds.  A folder error never fails the run — the affected mappings
+    keep their original refs."""
+
+    def __init__(self, store, op, bound, device=False, label="early-fold"):
+        self.store = store
+        self.op = op
+        self.bound = max(1, int(bound))
+        self.device = device
+        self.label = label
+        self.folded = {}    # job idx -> replacement mapping
+        self.fold_delta = {}  # pid -> staged-bytes minus folded-bytes
+        self.stats = {"published": 0, "early_folded_blocks": 0,
+                      "bytes_in": 0, "bytes_out": 0, "fold_seconds": 0.0,
+                      "overlap_seconds": 0.0, "stall_seconds": 0.0,
+                      "queue_peak_bytes": 0, "queue_depth_series": []}
+        self._q = _queue.Queue()
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._disabled = False
+        self._t0 = time.perf_counter()
+        self._pool_done_at = None
+        self._thread = threading.Thread(
+            target=self._run, name="dampr-tpu-pipefold", daemon=True)
+        self._thread.start()
+
+    def _sample_depth(self):
+        # Bounded queue-depth series for stats()["pipeline"]: decimate by
+        # dropping every other sample once the cap is hit, so the series
+        # stays an even sketch of the whole stage.
+        series = self.stats["queue_depth_series"]
+        series.append([round(time.perf_counter() - self._t0, 4),
+                       self._pending])
+        if len(series) > 512:
+            del series[::2]
+
+    def publish(self, idx, mapping):
+        """Dispatch-thread side: charge, bound, enqueue.  ``mapping`` is
+        the committed job result ({pid: [refs]}); the folder may replace
+        it wholesale in ``self.folded[idx]``."""
+        _faults.check("stream_publish")
+        if self._disabled:
+            return
+        nb = sum(ref.total_bytes for refs in mapping.values()
+                 for ref in refs)
+        if nb <= 0:
+            return
+        wait_t0 = 0.0
+        with self._cv:
+            while (self._pending > 0 and self._pending + nb > self.bound
+                    and not self._disabled):
+                if not wait_t0:
+                    wait_t0 = _trace.now()
+                self._cv.wait(0.05)
+            if self._disabled:
+                if wait_t0:
+                    self.stats["stall_seconds"] += _trace.now() - wait_t0
+                return
+            self._pending += nb
+            self.stats["queue_peak_bytes"] = max(
+                self.stats["queue_peak_bytes"], self._pending)
+            self._sample_depth()
+        if wait_t0:
+            self.stats["stall_seconds"] += _trace.now() - wait_t0
+            # "stream-wait" (not the overlap executor's "pipe-wait"):
+            # critpath classifies this name as pipeline-stall, whose
+            # doctor fix (raise pipeline_queue_bytes) differs from the
+            # overlap knobs.
+            _trace.complete("stall", "stream-wait", wait_t0)
+        self.store.reserve_overlap(nb)
+        self.stats["published"] += 1
+        self.stats["bytes_in"] += nb
+        self._q.put((idx, mapping, nb))
+
+    def _value_dtype_ok(self, block):
+        dt = getattr(getattr(block, "values", None), "dtype", None)
+        if dt is None:
+            return False
+        if self.op.kind == "sum":
+            return dt.kind in "iub"
+        return dt.kind in "iubf"
+
+    def _fold_one(self, idx, mapping):
+        """Fold one job mapping, atomically: every pid folds into a fresh
+        ref BEFORE any original drops, so a mid-mapping failure (or a
+        dtype disable) leaves the original, correct refs in place."""
+        out = {}
+        blocks_in = sum(len(refs) for refs in mapping.values())
+        try:
+            with _trace.span("pipeline", self.label, lane="pipeline",
+                             blocks=blocks_in):
+                for pid, refs in mapping.items():
+                    if not refs:
+                        continue
+                    if self._disabled:
+                        raise _FoldDeclined()
+                    blocks = [r.get() for r in refs]
+                    merged = (blocks[0] if len(blocks) == 1
+                              else Block.concat(blocks))
+                    del blocks
+                    if not self._value_dtype_ok(merged):
+                        # Ineligible value lane: disable for the whole
+                        # stage (one dtype per stage output).
+                        with self._cv:
+                            self._disabled = True
+                            self._cv.notify_all()
+                        raise _FoldDeclined()
+                    folded = segment.fold_block(merged, self.op)
+                    out[pid] = [self.store.register(folded,
+                                                    device=self.device)]
+        except _FoldDeclined:
+            for refs in out.values():
+                for r in refs:
+                    self.store.drop_ref(r)
+            return None
+        except Exception:
+            for refs in out.values():
+                for r in refs:
+                    self.store.drop_ref(r)
+            raise
+        for pid, refs in mapping.items():
+            if pid in out:
+                self.stats["early_folded_blocks"] += len(refs)
+                for r in refs:
+                    self.store.drop_ref(r)
+            else:
+                out[pid] = list(refs)
+        return out
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            idx, mapping, nb = item
+            t0 = time.perf_counter()
+            try:
+                if not self._disabled:
+                    replacement = self._fold_one(idx, mapping)
+                    if replacement is not None:
+                        self.folded[idx] = replacement
+                        self.stats["bytes_out"] += sum(
+                            ref.total_bytes for refs in replacement.values()
+                            for ref in refs)
+                        self._note_delta(mapping, replacement)
+            except Exception:  # noqa: BLE001 - folding is an optimization;
+                #               originals stay registered, the run is fine
+                log.warning("early-fold worker failed; disabling folding "
+                            "for this stage (originals kept)",
+                            exc_info=True)
+                with self._cv:
+                    self._disabled = True
+                    self._cv.notify_all()
+            finally:
+                dt = time.perf_counter() - t0
+                self.stats["fold_seconds"] += dt
+                if self._pool_done_at is None:
+                    self.stats["overlap_seconds"] += dt
+                self.store.release_overlap(nb)
+                with self._cv:
+                    self._pending = max(0, self._pending - nb)
+                    self._sample_depth()
+                    self._cv.notify_all()
+
+    def _note_delta(self, mapping, replacement):
+        """Per-pid staged-vs-folded byte delta: the reduce's size gates
+        (tiny fast path) must decide on STAGED bytes, or the pipelined
+        run could take a different branch than the staged one."""
+        for pid, refs in mapping.items():
+            orig = sum(r.total_bytes for r in refs)
+            now = sum(r.total_bytes for r in replacement.get(pid, ()))
+            self.fold_delta[pid] = self.fold_delta.get(pid, 0) + max(
+                0, orig - now)
+
+    def mark_pool_done(self):
+        """Called when the map stage's job pool returns: fold seconds
+        after this point no longer overlap map compute."""
+        self._pool_done_at = time.perf_counter()
+
+    def finish(self):
+        """Drain, join, and return {idx: replacement mapping}."""
+        self._q.put(None)
+        self._thread.join(timeout=60.0)
+        if self._thread.is_alive():
+            # Wedged folder at shutdown: stop consuming its results (the
+            # originals are still registered and correct) and let the
+            # daemon thread release its reservations as it drains.
+            log.warning("early-fold worker did not drain within 60s; "
+                        "using unfolded mappings")
+            with self._cv:
+                self._disabled = True
+                self._cv.notify_all()
+            return {}, dict(self.stats)
+        return dict(self.folded), dict(self.stats)
+
+
+class _ChainedOutput(object):
+    """Placeholder env entry for a streamed chain producer's output
+    (docs/pipeline.md): the stage's blocks flowed straight into the
+    consumer's jobs and were dropped as each one was consumed — nothing
+    ever materialized.  Duck-types the probes stage bookkeeping applies
+    to arbitrary env entries (cleanup ignores non-PartitionSets)."""
+
+    __slots__ = ("records",)
+
+    def __init__(self, records):
+        self.records = records
+
+    def total_records(self):
+        return self.records
+
+
 class _SharedScanChunk(object):
     """One-read view of a tap chunk shared by scan-fused map stages: the
     first read_bytes() materializes, later readers (including streaming
@@ -810,6 +1048,21 @@ class MTRunner(object):
         # A dispatch decision like _shuffle_targets — never stage
         # options, so resume/cache fingerprints stay history-independent.
         self._handoff_sids = set()
+        # Streamed stage edges (plan/pipeline.py): producer sid -> edge
+        # hint for the barrier-free executor.  Same dispatch-hint
+        # discipline as _shuffle_targets/_handoff_sids — never stage
+        # options, so fingerprints stay history-independent.
+        self._pipeline_edges = {}
+        # Per-run pipelined-execution accounting (stats()["pipeline"]).
+        self._pipeline_stats = {
+            "executed": 0, "degraded": 0, "published": 0,
+            "early_folded_blocks": 0, "bytes_in": 0, "bytes_out": 0,
+            "fold_seconds": 0.0, "overlap_seconds": 0.0,
+            "stall_seconds": 0.0, "queue_peak_bytes": 0,
+            "queue_depth_series": []}
+        # Consumer-stage results a streamed chain computed ahead of the
+        # stage walk (the consumer's loop turn consumes, not recomputes).
+        self._chain_results = {}
         self.streamed_assoc_folds = 0  # over-budget vectorized accumulators
         self.retries_total = 0  # transient-failure job re-executions
         self._retry_lock = threading.Lock()
@@ -893,7 +1146,13 @@ class MTRunner(object):
                 return False
         return True
 
-    def _pool_run(self, fn, jobs, n_workers, label=None, speculative=True):
+    def _pool_run(self, fn, jobs, n_workers, label=None, speculative=True,
+                  on_result=None):
+        """``on_result(idx, result)`` — the pipelined executor's publish
+        hook — runs on the dispatching thread as each job's COMMITTED
+        result is collected (attempt rollback, retries, and speculation
+        all resolved), in job order.  It may block (backpressure); job
+        workers keep running ahead, bounded by the store budget."""
         retries = settings.job_retries
         if retries:
             inner = fn
@@ -988,9 +1247,17 @@ class MTRunner(object):
                     m.counter_add("run.jobs_done", 1)
                     st["jobs_done"] = st.get("jobs_done", 0) + 1
 
+        def collect(results_iter):
+            out = []
+            for r in results_iter:
+                if on_result is not None:
+                    on_result(len(out), r)
+                out.append(r)
+            return out
+
         n_workers = max(1, min(n_workers, len(jobs), settings.max_processes))
         if n_workers == 1 or len(jobs) <= 1:
-            return [fn(j) for j in jobs]
+            return collect(fn(j) for j in jobs)
         ctl = _mitigate.active()
         if ctl is not None:
             # Mitigation-aware dispatch: rank-owned per-worker queues
@@ -1000,12 +1267,212 @@ class MTRunner(object):
             # writes would race on one path); quarantine-armed runs
             # don't either (a losing duplicate's quarantine commits
             # would double-count poison records against the budget).
-            return _mitigate.pool_dispatch(
+            results = _mitigate.pool_dispatch(
                 ctl, fn, jobs, n_workers, store=self.store,
                 speculative=(speculative and self._quarantine is None),
                 spec_fn=fn_speculative)
+            if on_result is not None:
+                # Mitigation dispatch returns only after every job
+                # finished; publish post-hoc in order so the consumer
+                # still sees each result exactly once (no overlap —
+                # streamed stages degrade under an armed controller).
+                for i, r in enumerate(results):
+                    on_result(i, r)
+            return results
         with ThreadPoolExecutor(max_workers=n_workers) as pool:
-            return list(pool.map(fn, jobs))
+            return collect(pool.map(fn, jobs))
+
+    # -- pipelined (barrier-free) dispatch ---------------------------------
+    def _stage_folder(self, stage_id, feeds_dev, run_mode, pin):
+        """Early-fold folder for a streamed map->keyed-fold edge, or None
+        when the edge isn't streamed (the common case: one dict probe) or
+        a runtime condition degrades it back to the staged barrier."""
+        hint = self._pipeline_edges.get(stage_id)
+        if hint is None or hint["mode"] != "early_fold":
+            return None
+        if not settings.pipeline_enabled():
+            return None
+        degrade = None
+        if _mitigate.active() is not None:
+            degrade = "mitigation controller armed"
+        elif run_mode or pin:
+            degrade = "producer run mode incompatible"
+        if degrade is None:
+            cons = self.graph.stages[hint["dst"]]
+            op = getattr(getattr(cons, "reducer", None), "op", None)
+            if op is None or op.kind not in ("sum", "min", "max"):
+                degrade = "consumer op not early-foldable"
+        if degrade is not None:
+            self._pipeline_stats["degraded"] += 1
+            log.info("streamed edge s%s degraded to staged barrier: %s",
+                     stage_id, degrade)
+            return None
+        bound = settings.pipeline_queue_bytes or max(
+            1, self.store.budget // 4)
+        self._pipeline_stats["executed"] += 1
+        _trace.instant("pipeline", "streamed-edge", src=stage_id,
+                       dst=hint["dst"], mode="early_fold")
+        # feeds_dev rides through so folded replacements register in the
+        # same tier the originals did (the reduce's device fold reads
+        # them without an extra host round-trip).
+        return _StreamFolder(self.store, op, bound, device=feeds_dev)
+
+    def _note_pipeline(self, stage_id, fstats):
+        """Merge one streamed edge's folder stats into the run total."""
+        ps = self._pipeline_stats
+        for k in ("published", "early_folded_blocks", "bytes_in",
+                  "bytes_out", "fold_seconds", "overlap_seconds",
+                  "stall_seconds"):
+            ps[k] += fstats[k]
+        ps["queue_peak_bytes"] = max(ps["queue_peak_bytes"],
+                                     fstats["queue_peak_bytes"])
+        series = ps["queue_depth_series"]
+        series.extend([stage_id, t, b]
+                      for t, b in fstats["queue_depth_series"])
+        if len(series) > 1024:
+            del series[: len(series) - 1024]
+
+    def _wrap_chain_job(self, fn):
+        """Retry + trace wrapper for chain consumer jobs: the _pool_run
+        stack minus speculation (chain never speculates — duplicate
+        consumer jobs would double-emit) and minus the per-stage job
+        tally (the consumer's job count isn't known up front)."""
+        retries = settings.job_retries
+
+        def run(job):
+            for attempt in range(retries + 1):
+                try:
+                    with self.store.attempt():
+                        if _trace.enabled():
+                            with _trace.span("job", "chain"):
+                                return fn(job)
+                        return fn(job)
+                except Exception as e:
+                    kind = _faults.classify(e)
+                    if kind == "fatal" or attempt == retries:
+                        raise
+                    delay = (_faults.backoff(attempt)
+                             if kind == "transient" else 0.0)
+                    with self._retry_lock:
+                        self.retries_total += 1
+                        self._backoff_seconds += delay
+                    _trace.instant("retry", "chain", attempt=attempt + 1,
+                                   kind=kind)
+                    log.warning(
+                        "chain job failed (%s, attempt %d/%d), retrying%s",
+                        kind, attempt + 1, retries + 1,
+                        " in %.0f ms" % (delay * 1000) if delay else "",
+                        exc_info=True)
+                    if delay:
+                        time.sleep(delay)
+        return run
+
+    def _run_chain(self, sid_p, stage_p, sid_c, env):
+        """Streamed map->map chain (docs/pipeline.md): the consumer's
+        jobs run per completed producer partition block while the
+        producer stage is still executing, and the producer's output
+        never materializes as a stage-boundary PartitionSet.
+
+        Byte-identity contract: consumer results collect in the staged
+        job order — (producer pid, producer job idx) — which is exactly
+        the order the staged executor's ``all_refs()`` walk would have
+        fed them in, and per-pid record order survives compaction's
+        order-preserving concat on both legs.  Block BOUNDARIES differ
+        (the staged leg compacts producer refs first), which the plan
+        pass already proved invisible: chain edges require a pure record
+        stream with no boundary-sensitive consumer downstream.
+
+        Returns (producer placeholder, records, n_jobs) for the
+        producer's stage bookkeeping and stashes the consumer's result
+        in ``self._chain_results[sid_c]``; returns None to degrade to
+        the staged barrier."""
+        stage_c = self.graph.stages[sid_c]
+        if _mitigate.active() is not None:
+            self._pipeline_stats["degraded"] += 1
+            log.info("streamed edge s%s degraded to staged barrier: "
+                     "mitigation controller armed", sid_p)
+            return None
+        entries = [env[s] for s in stage_p.inputs]
+        chunks = self._as_chunks(entries[0])
+        supplementary = [self._as_chunks(e) for e in entries[1:]]
+        (job_p, comb_p, pin_p, fr_p, _sp, dev_p, run_p,
+         _wp) = self._map_job_factory(stage_p, supplementary, sid=sid_p)
+        (job_c, comb_c, pin_c, fr_c, _sc, dev_c, run_c,
+         _wc) = self._map_job_factory(stage_c, [], sid=sid_c)
+        if (comb_p is not None or pin_p or fr_p or dev_p or run_p
+                or comb_c is not None or pin_c or fr_c or dev_c or run_c):
+            # The factories disagree with the plan-time gates (a settings
+            # override between plan and run, or a shape the pass missed):
+            # the staged barrier is always correct.
+            self._pipeline_stats["degraded"] += 1
+            log.info("streamed edge s%s degraded to staged barrier: "
+                     "factory mode incompatible", sid_p)
+            return None
+
+        self._pipeline_stats["executed"] += 1
+        _trace.instant("pipeline", "streamed-edge", src=sid_p, dst=sid_c,
+                       mode="chain")
+        n_maps = stage_p.options.get("n_maps", self.n_maps)
+        wrapped_c = self._wrap_chain_job(job_c)
+        futures = {}   # (producer pid, producer job idx) -> (future, refs)
+        spans = []     # (t0, t1) per consumer job, for overlap accounting
+        spans_lock = threading.Lock()
+        acct = {"bytes_in": 0, "records_in": 0}
+
+        def timed_c(ds, _run=wrapped_c):
+            t0 = time.perf_counter()
+            try:
+                return _run(ds)
+            finally:
+                with spans_lock:
+                    spans.append((t0, time.perf_counter()))
+
+        def publish(idx, mapping):
+            _faults.check("stream_publish")
+            self._pipeline_stats["published"] += 1
+            for pid in sorted(k for k in mapping if k != "_sorted"):
+                refs = list(mapping[pid])
+                if not refs:
+                    continue
+                acct["bytes_in"] += sum(r.total_bytes for r in refs)
+                acct["records_in"] += sum(len(r) for r in refs)
+                futures[(pid, idx)] = (
+                    pool_c.submit(timed_c, BlockDataset(refs)), refs)
+
+        pool_c = ThreadPoolExecutor(
+            max_workers=max(1, min(n_maps, settings.max_processes)),
+            thread_name_prefix="dampr-tpu-chain")
+        pool_done_at = None
+        try:
+            self._pool_run(job_p, chunks, n_maps, label="map",
+                           speculative=False, on_result=publish)
+            pool_done_at = time.perf_counter()
+            mappings_c = []
+            for key in sorted(futures):
+                fut, refs = futures[key]
+                mappings_c.append(fut.result())
+                for r in refs:
+                    self.store.drop_ref(r)
+        finally:
+            pool_c.shutdown(wait=True)
+        fold_s = sum(t1 - t0 for t0, t1 in spans)
+        overlap_s = sum(max(0.0, min(t1, pool_done_at) - t0)
+                        for t0, t1 in spans) if pool_done_at else 0.0
+        bytes_out = sum(r.total_bytes for m in mappings_c
+                        for refs in m.values() for r in refs)
+        self._note_pipeline(sid_p, {
+            "published": 0, "early_folded_blocks": 0,
+            "bytes_in": acct["bytes_in"], "bytes_out": bytes_out,
+            "fold_seconds": fold_s, "overlap_seconds": overlap_s,
+            "stall_seconds": 0.0, "queue_peak_bytes": 0,
+            "queue_depth_series": []})
+        pset = self._collect_partitions(
+            mappings_c, comb_c, pin_c, fr_c, device=dev_c,
+            sorted_runs=run_c, handoff=sid_c in self._handoff_sids)
+        self._chain_results[sid_c] = (
+            pset, pset.total_records(), len(futures))
+        return (_ChainedOutput(acct["records_in"]), acct["records_in"],
+                len(chunks))
 
     # -- stage input views --------------------------------------------------
     def _as_chunks(self, entry):
@@ -1049,12 +1516,35 @@ class MTRunner(object):
             stage, supplementary, sid=stage_id)
 
         n_maps = stage.options.get("n_maps", self.n_maps)
-        results = self._pool_run(job, chunks, n_maps, label="map",
-                                 speculative=self._speculation_ok(stage))
+        folder = self._stage_folder(stage_id, feeds_dev=feeds_dev,
+                                    run_mode=run_mode, pin=pin)
+        replaced = {}
+        try:
+            results = self._pool_run(
+                job, chunks, n_maps, label="map",
+                speculative=self._speculation_ok(stage),
+                on_result=folder.publish if folder is not None else None)
+        finally:
+            if folder is not None:
+                # finish() drains even on a failed stage, so every queued
+                # reservation releases — kill-mid-stream never orphans
+                # queue entries against the budget.
+                folder.mark_pool_done()
+                replaced, fstats = folder.finish()
+                self._note_pipeline(stage_id, fstats)
+        for idx, mapping in replaced.items():
+            if idx < len(results):
+                results[idx] = mapping
         pset = self._collect_partitions(
             results, combine_op, pin, feeds_reduce, device=feeds_dev,
             sorted_runs=run_mode,
             handoff=stage_id in self._handoff_sids)
+        if folder is not None and folder.fold_delta:
+            # Staged-bytes pinning for the reduce's size gates: the tiny
+            # fast path must branch on what the partition WOULD have
+            # weighed unfolded, or pipeline on/off could take different
+            # emit paths (hash-order vs key-order layouts).
+            pset.pipeline_fold_delta = dict(folder.fold_delta)
         return pset, pset.total_records(), len(chunks)
 
     def _collect_partitions(self, mappings, combine_op, pin, feeds_reduce,
@@ -2337,8 +2827,14 @@ class MTRunner(object):
         thr = settings.streaming_reduce_threshold
         if thr is None:
             thr = self.store.budget
-        if sum(getattr(r, 'total_bytes', r.nbytes)
-               for r in refs) > min(limit, thr):
+        # Streamed-edge inputs gate on STAGED bytes: early folds shrink
+        # the refs, but this fast path emits a different (hash-order)
+        # layout than the per-partition jobs, so the branch decision must
+        # match what the staged run would have taken byte-for-byte.
+        staged_extra = sum(getattr(entries[0], "pipeline_fold_delta",
+                                   {}).values())
+        if staged_extra + sum(getattr(r, 'total_bytes', r.nbytes)
+                              for r in refs) > min(limit, thr):
             return None
         merged = Block.concat([r.get() for r in refs])
         if not len(merged):
@@ -2768,7 +3264,11 @@ class MTRunner(object):
             self._exchange_snapshot = (
                 dict(px.sent_bytes_per_device),
                 dict(px.received_bytes_per_device),
-                dict(px.pair_bytes_per_route))
+                dict(px.pair_bytes_per_route),
+                {"codec_raw": px.codec_raw_bytes,
+                 "codec_wire": px.codec_wire_bytes,
+                 "pack_seconds": px.pack_seconds_total,
+                 "pack_hidden": px.pack_hidden_seconds_total})
         except Exception:
             self._exchange_snapshot = None
         return rec
@@ -2901,7 +3401,7 @@ class MTRunner(object):
             from .parallel import exchange as px
         except Exception:
             return None
-        sent0, recv0, pair0 = self._exchange_snapshot
+        sent0, recv0, pair0, sc0 = self._exchange_snapshot
 
         def delta(cur, base):
             out = {}
@@ -2916,13 +3416,31 @@ class MTRunner(object):
         pair = delta(px.pair_bytes_per_route, pair0)
         if not (sent or recv or pair):
             return None
-        return {
+        section = {
             "sent_per_device": {str(k): v for k, v in sorted(sent.items())},
             "received_per_device": {str(k): v
                                     for k, v in sorted(recv.items())},
             # JSON-safe route triples [src_device, dst_device, bytes]
             "routes": [[s, d, n] for (s, d), n in sorted(pair.items())],
         }
+        raw = px.codec_raw_bytes - sc0["codec_raw"]
+        wire = px.codec_wire_bytes - sc0["codec_wire"]
+        if raw > 0:
+            # Per-route payload codec evidence (settings.exchange_codec):
+            # pre-compression bytes vs wire bytes this run.
+            section["codec"] = {
+                "raw_bytes": raw, "wire_bytes": wire,
+                "savings_fraction": round(1.0 - wire / float(raw), 4)}
+        packed = px.pack_seconds_total - sc0["pack_seconds"]
+        hidden = px.pack_hidden_seconds_total - sc0["pack_hidden"]
+        if packed > 1e-9:
+            # Double-buffered schedule evidence: how much of the host
+            # pack time hid behind in-flight collectives this run.
+            section["overlap"] = {
+                "pack_seconds": round(packed, 4),
+                "hidden_seconds": round(hidden, 4),
+                "hidden_fraction": round(hidden / packed, 4)}
+        return section
 
     def _faults_section(self):
         """The per-run ``stats()["faults"]`` payload: this run's share of
@@ -2949,6 +3467,36 @@ class MTRunner(object):
             section["plan"] = plan.spec
             section["injected"] = dict(injected)
         return section
+
+    def _pipeline_section(self):
+        """The per-run ``stats()["pipeline"]`` payload: plan-time edge
+        decisions (from the plan report) plus the runtime folder/chain
+        counters.  overlap_fraction is the share of streamed-consumer
+        seconds that ran WHILE the producing stage's pool was still
+        busy — the wall-clock the pipelining actually hid."""
+        ps = self._pipeline_stats
+        rep = ((self.plan_report or {}).get("pipeline") or {})
+        fold_s = ps["fold_seconds"]
+        return {
+            "enabled": settings.pipeline_enabled(),
+            "edges_streamed": rep.get("streamed", 0),
+            "edges_barrier": rep.get("barriers", 0),
+            "executed": ps["executed"],
+            "degraded": ps["degraded"],
+            "published": ps["published"],
+            "early_folded_blocks": ps["early_folded_blocks"],
+            "bytes_in": ps["bytes_in"],
+            "bytes_out": ps["bytes_out"],
+            "fold_seconds": round(fold_s, 4),
+            "overlap_seconds": round(ps["overlap_seconds"], 4),
+            "overlap_fraction": (round(ps["overlap_seconds"] / fold_s, 4)
+                                 if fold_s > 1e-9 else 0.0),
+            "stall_seconds": round(ps["stall_seconds"], 4),
+            "queue_peak_bytes": ps["queue_peak_bytes"],
+            "queue_depth_series": [[sid, round(t, 4), b]
+                                   for sid, t, b
+                                   in ps["queue_depth_series"]],
+        }
 
     def _finalize_obs(self, wall_start, wall, dev):
         """Build the per-run summary (the stats.json payload) and, when
@@ -3088,6 +3636,10 @@ class MTRunner(object):
                 "handoff_degrades": sto.handoff_degrades,
             },
             "streamed_assoc_folds": self.streamed_assoc_folds,
+            # Barrier-free pipelining evidence (docs/pipeline.md):
+            # streamed-edge decisions, early-fold/chain runtime counters,
+            # and the overlap the dissolved barriers actually bought.
+            "pipeline": self._pipeline_section(),
             "retries": self.retries_total,
             # Failure-recovery summary (dampr_tpu.faults): classified
             # retries absorbed at every layer (job re-executions + the IO
@@ -3477,9 +4029,39 @@ class MTRunner(object):
                     log.info("Stage %s aliased (identity checkpoint): %s",
                              sid + 1, st.as_dict())
                     continue
-                if sid in fused:
+                if sid in self._chain_results:
+                    # Consumer half of a streamed chain: its jobs already
+                    # ran, overlapped with the producer's, at the
+                    # producer's turn (docs/pipeline.md).  Normal stage
+                    # bookkeeping below still applies.
+                    result, nrec, njobs = self._chain_results.pop(sid)
+                elif sid in fused:
                     result, nrec, njobs = fused.pop(sid)
                 else:
+                    chained = None
+                    hint = self._pipeline_edges.get(sid)
+                    if (hint is not None and hint["mode"] == "chain"
+                            and settings.pipeline_enabled()):
+                        chained = self._run_chain(
+                            sid, stage, hint["dst"], env)
+                    if chained is not None:
+                        result, nrec, njobs = chained
+                        to_delete.append(stage.output)
+                        env[stage.output] = result
+                        self.store.drain_writes()
+                        st = StageStats(sid, "map-chained")
+                        st.n_jobs = njobs
+                        st.records_out = nrec
+                        st.seconds = time.time() - t0
+                        self._fill_stage_io(st, stage, env, result, snap)
+                        self.stats.append(st)
+                        _trace.complete(
+                            "stage", "s{}:map-chained".format(sid),
+                            t0_span, lane="stages", records=nrec,
+                            jobs=njobs)
+                        log.info("Stage %s chained into s%s: %s", sid + 1,
+                                 hint["dst"] + 1, st.as_dict())
+                        continue
                     group = [g for g in self._scan_share_group(
                         sid, stage, env)
                         if g[0] not in plan

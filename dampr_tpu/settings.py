@@ -258,6 +258,33 @@ readahead_chunks = int(os.environ.get("DAMPR_TPU_READAHEAD", "2"))
 #: fold interleave on the job thread, the pre-round-6 behavior).
 overlap_windows = int(os.environ.get("DAMPR_TPU_OVERLAP_WINDOWS", "2"))
 
+#: Barrier-free pipelined execution (docs/pipeline.md): the plan's
+#: ``pipeline`` pass marks producer->consumer stage edges ``streamed``
+#: wherever byte-identity is provable (map->keyed-fold via early partial
+#: folds, unfused map->map chains, sorted-run merge -> final read), and
+#: the runner dissolves the stage barrier on those edges — completed
+#: partitions publish into a bounded backpressure queue the consumer
+#: works from while the producer is still running.  "auto"/"on" enable
+#: it; "off"/"0" (the kill switch) reproduces staged execution
+#: byte-identically.  Every edge decision — streamed or barrier, with
+#: its reason — lands in the plan report and ``explain()`` regardless.
+pipeline = os.environ.get("DAMPR_TPU_PIPELINE", "auto")
+
+
+def pipeline_enabled():
+    return str(pipeline).lower() not in ("off", "0", "false", "no")
+
+
+#: Byte bound for the pipelined publish queue (the backpressure
+#: contract): at most this many bytes of completed-but-unconsumed
+#: partition output sit between a streamed edge's producer and consumer;
+#: past it the publisher blocks (a ``pipe-wait`` stall span) until the
+#: consumer drains.  Queued bytes are charged against the run budget
+#: through ``RunStore.reserve_overlap``, so spill admission sees the
+#: pressure.  0 (default) resolves to a quarter of the stage memory
+#: budget at run time.
+pipeline_queue_bytes = int(os.environ.get("DAMPR_TPU_PIPELINE_QUEUE", "0"))
+
 #: Spill-lean sorted-run mode for map outputs no reduce ever consumes
 #: (external sorts: ``ParseNumbers -> checkpoint``): each map job registers
 #: its chunk's output as ONE key-sorted run instead of hash-fanning it into
@@ -710,6 +737,17 @@ exchange_coding = os.environ.get("DAMPR_TPU_EXCHANGE_CODING", "off")
 
 def exchange_coding_enabled():
     return str(exchange_coding).lower() in ("camr", "on", "1", "true")
+
+
+#: Per-route exchange payload compression: each (src, dst) blob is
+#: compressed before the chunked all_to_all schedule is planned, so the
+#: schedule's HBM-budget packing and the gloo wire both see compressed
+#: bytes.  "auto" (default) picks the best codec available in the
+#: environment (zstd > lz4 > off — io/codecs.py ladder); a codec name
+#: pins it; "off" ships raw bytes.  Wire-vs-raw byte counts land in
+#: ``stats()["mesh"]["exchange"]``; byte-exactness against the uncoded
+#: path is pinned by tests (decompression restores the exact payload).
+exchange_codec = os.environ.get("DAMPR_TPU_EXCHANGE_CODEC", "auto")
 
 
 #: Whole-run retry budget for ``run(resume="auto")``: a failed run

@@ -1160,7 +1160,7 @@ class PartitionSet(object):
     the invariants that stage would have established."""
 
     __slots__ = ("parts", "n_partitions", "hash_routed", "hash_sorted",
-                 "key_sorted_runs", "shuffle_target")
+                 "key_sorted_runs", "shuffle_target", "pipeline_fold_delta")
 
     def __init__(self, n_partitions, hash_routed=False, hash_sorted=False,
                  key_sorted_runs=False):
@@ -1173,6 +1173,10 @@ class PartitionSet(object):
         # redistribution (None = undecided): lazily-read sorted outputs
         # consult it when they range-exchange at read time.
         self.shuffle_target = None
+        # Streamed-edge provenance (runner pipelined executor): per-pid
+        # byte shrinkage from early partial folds.  Size-gated consumers
+        # add it back so their branch decisions match a staged run.
+        self.pipeline_fold_delta = {}
 
     def add(self, pid, ref):
         self.parts.setdefault(pid, []).append(ref)

@@ -1,0 +1,357 @@
+"""Barrier-free pipelined execution (docs/pipeline.md): streamed-edge
+plan decisions, pipelined-vs-staged byte identity over randomized
+pipelines, backpressure and publish-fault cleanliness, the exchange
+payload codec, and attempt-scoped frame read timing."""
+
+import operator
+import random
+import time
+import types
+import uuid
+
+import pytest
+
+from dampr_tpu import Dampr, faults, settings
+from dampr_tpu.io import codecs
+from dampr_tpu.plan import pipeline as plan_pipeline
+
+
+@pytest.fixture(autouse=True)
+def pipelined_host():
+    """Pipelining on, mesh paths off: the streamed-edge analysis
+    conservatively bars streaming whenever a mesh fold/exchange could
+    engage, and the 8-device test rig would otherwise bar every edge."""
+    saved = (settings.pipeline, settings.pipeline_queue_bytes,
+             settings.mesh_fold, settings.mesh_exchange, settings.sort_runs,
+             settings.optimize, settings.exchange_codec, settings.faults)
+    settings.pipeline = "auto"
+    settings.mesh_fold = "off"
+    settings.mesh_exchange = "off"
+    yield
+    (settings.pipeline, settings.pipeline_queue_bytes, settings.mesh_fold,
+     settings.mesh_exchange, settings.sort_runs, settings.optimize,
+     settings.exchange_codec, settings.faults) = saved
+    faults.clear()
+
+
+def _decisions(pipe, runner=None):
+    return plan_pipeline.analyze(pipe.pmer.graph, [pipe.source],
+                                 runner=runner)
+
+
+def _run_both(pipe):
+    """(pipelined read, staged read) of the same handle."""
+    settings.pipeline = "auto"
+    em = pipe.run(name="pipe-on-%s" % uuid.uuid4().hex[:8])
+    on, stats = em.read(), em.stats()
+    em.delete()
+    settings.pipeline = "0"
+    em = pipe.run(name="pipe-off-%s" % uuid.uuid4().hex[:8])
+    off = em.read()
+    em.delete()
+    settings.pipeline = "auto"
+    return on, off, stats
+
+
+class TestPlanDecisions:
+    def test_assoc_fold_streams_early_fold(self):
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, operator.add))
+        d = _decisions(pipe)
+        assert any(e["decision"] == "streamed" and e["mode"] == "early_fold"
+                   for e in d), d
+
+    def test_lambda_binop_keeps_barrier(self):
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, lambda a, b: a + b))
+        d = _decisions(pipe)
+        assert not any(e["mode"] == "early_fold" for e in d)
+        assert any("order-sensitive" in e["reason"] for e in d), d
+
+    def test_checkpoint_keeps_barrier(self):
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1).checkpoint()
+                .fold_by(lambda x: x % 5, operator.add))
+        d = _decisions(pipe)
+        # Both edges touching the checkpoint stage stay barriers; edges
+        # strictly downstream of it may still stream.
+        ck = [e for e in d if "checkpoint" in e["reason"]]
+        assert len(ck) >= 2, d
+        assert all(e["decision"] == "barrier" for e in ck)
+
+    def test_multi_consumer_keeps_barrier(self):
+        base = Dampr.memory(list(range(100)), partitions=4).map(
+            lambda x: x + 1)
+        a = base.map(lambda x: x * 2)
+        b = base.filter(lambda x: x % 2 == 0)
+        merged = a.pmer.graph.union(b.pmer.graph)
+        d = plan_pipeline.analyze(merged, [a.source, b.source])
+        assert any(e["reason"] == "multi-consumer output" for e in d), d
+
+    def test_mesh_possible_bars_streaming(self):
+        settings.mesh_fold = "on"
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, operator.add))
+        d = _decisions(pipe)
+        assert not any(e["decision"] == "streamed" and e["dst"] is not None
+                       for e in d)
+        assert any("mesh" in e["reason"] for e in d), d
+
+    def test_resume_bars_streaming(self):
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, operator.add))
+        fake = types.SimpleNamespace(resume=True, _handoff_sids=set(),
+                                     _shuffle_targets={})
+        d = _decisions(pipe, runner=fake)
+        assert not any(e["decision"] == "streamed" and e["dst"] is not None
+                       for e in d)
+        assert any("resume" in e["reason"] for e in d), d
+
+    def test_host_shuffle_does_not_bar_mesh_does(self):
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, operator.add))
+        edge = next(e for e in _decisions(pipe)
+                    if e["decision"] == "streamed" and e["dst"] is not None)
+        host = types.SimpleNamespace(
+            resume=False, _handoff_sids=set(),
+            _shuffle_targets={edge["dst"]: "host"})
+        assert any(e["decision"] == "streamed" and e["dst"] is not None
+                   for e in _decisions(pipe, runner=host))
+        mesh = types.SimpleNamespace(
+            resume=False, _handoff_sids=set(),
+            _shuffle_targets={edge["dst"]: "mesh"})
+        d = _decisions(pipe, runner=mesh)
+        assert not any(e["decision"] == "streamed" and e["dst"] is not None
+                       for e in d)
+
+    def test_map_chain_streams_without_sorted_runs(self):
+        settings.sort_runs = "off"
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1).map(lambda x: x * 2))
+        d = _decisions(pipe)
+        assert any(e["decision"] == "streamed" and e["mode"] == "chain"
+                   for e in d), d
+
+    def test_sorted_runs_bar_map_chain(self):
+        settings.sort_runs = "on"
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1).map(lambda x: x * 2))
+        d = _decisions(pipe)
+        assert not any(e["mode"] == "chain" for e in d)
+        assert any("sorted-run" in e["reason"] for e in d), d
+
+    def test_explain_renders_decision_table(self):
+        pipe = (Dampr.memory(list(range(100)), partitions=4)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 5, operator.add))
+        text = pipe.explain()
+        assert "pipeline:" in text
+        assert "streamed" in text
+
+    def test_kill_switch_recorded_in_report(self):
+        settings.pipeline = "0"
+        pipe = (Dampr.memory(list(range(50)), partitions=2)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 3, operator.add))
+        text = pipe.explain()
+        assert "OFF" in text
+        # Decisions are still computed so the table stays inspectable.
+        assert any(e["decision"] == "streamed" for e in _decisions(pipe))
+
+
+class TestPipelinedExecution:
+    def test_early_fold_byte_identity_and_stats(self):
+        rng = random.Random(41)
+        data = [rng.randrange(0, 10000) for _ in range(20000)]
+        pipe = (Dampr.memory(data, partitions=8)
+                .map(lambda x: x * 3 + 1)
+                .fold_by(lambda x: x % 101, operator.add))
+        on, off, stats = _run_both(pipe)
+        assert on == off
+        ps = stats["pipeline"]
+        assert ps["enabled"] is True
+        assert ps["edges_streamed"] >= 1
+        assert ps["executed"] >= 1
+        assert ps["published"] >= 1
+        assert 0.0 <= ps["overlap_fraction"] <= 1.0
+        assert stats["plan"]["pipeline"]["streamed"] >= 1
+        assert stats["plan"]["pipeline"]["active"] is True
+
+    def test_chain_byte_identity(self):
+        settings.sort_runs = "off"
+        settings.optimize = False  # the optimizer would fuse the chain
+        data = list(range(5000))
+        pipe = (Dampr.memory(data, partitions=8)
+                .map(lambda x: x * 2)
+                .filter(lambda x: x % 3 != 0))
+        on, off, stats = _run_both(pipe)
+        assert on == off
+        assert stats["pipeline"]["executed"] >= 1
+
+    def test_backpressure_bound_respected(self):
+        # bound=1: a publish waits for the queue to drain fully, so the
+        # peak is one mapping's bytes, strictly below the stage total.
+        settings.pipeline_queue_bytes = 1
+        rng = random.Random(7)
+        data = [rng.randrange(0, 10000) for _ in range(20000)]
+        pipe = (Dampr.memory(data, partitions=8)
+                .map(lambda x: x + 7)
+                .fold_by(lambda x: x % 53, operator.add))
+        on, off, stats = _run_both(pipe)
+        assert on == off
+        ps = stats["pipeline"]
+        assert ps["queue_peak_bytes"] <= ps["bytes_in"]
+        if ps["published"] > 1:
+            assert ps["queue_peak_bytes"] < ps["bytes_in"]
+
+    def test_publish_fault_fails_clean(self):
+        data = list(range(8000))
+        pipe = (Dampr.memory(data, partitions=8)
+                .map(lambda x: x + 1)
+                .fold_by(lambda x: x % 7, operator.add))
+        faults.install(faults.FaultPlan(
+            "stream_publish:nth=1,kind=deterministic"))
+        try:
+            with pytest.raises(Exception):
+                pipe.run(name="pipe-kill-%s" % uuid.uuid4().hex[:8])
+            assert faults.injected_counts.get("stream_publish")
+        finally:
+            faults.clear()
+        # The failed streamed run leaves nothing behind that changes a
+        # re-run: pipelined and staged reads still agree byte-for-byte.
+        on, off, _ = _run_both(pipe)
+        assert on == off
+
+
+class TestPipelinedProperty:
+    """Randomized pipelines: pipelined and staged execution are
+    byte-identical on every optimizer/sorted-run leg."""
+
+    def _unary(self, rng, pipe):
+        roll = rng.randrange(5)
+        if roll == 0:
+            k = rng.randrange(1, 50)
+            return pipe.map(lambda x, k=k: x + k)
+        if roll == 1:
+            m = rng.randrange(2, 7)
+            return pipe.filter(lambda x, m=m: x % m != 0)
+        if roll == 2:
+            return pipe.flat_map(lambda x: (x, x + 1000000))
+        if roll == 3:
+            return pipe.sort_by(lambda x: -x)
+        return pipe.checkpoint()
+
+    def _build(self, rng, data):
+        pipe = Dampr.memory(data, partitions=rng.choice([4, 8, 13]))
+        for _ in range(rng.randrange(1, 4)):
+            pipe = self._unary(rng, pipe)
+        if rng.randrange(2):
+            m = rng.randrange(2, 9)
+            pipe = (pipe.fold_by(lambda x, m=m: x % m, operator.add)
+                    .map_values(lambda v: v * 3))
+        return pipe
+
+    @pytest.mark.parametrize("case", range(8))
+    def test_pipelined_equals_staged(self, case):
+        rng = random.Random(18000 + case)
+        settings.optimize = bool(case % 2)
+        settings.sort_runs = "off" if case % 4 < 2 else "auto"
+        data = [rng.randrange(0, 5000)
+                for _ in range(rng.randrange(200, 2000))]
+        pipe = self._build(rng, data)
+        on, off, _ = _run_both(pipe)
+        assert on == off, (
+            "case {} diverged: pipelined {} records vs staged {}".format(
+                case, len(on), len(off)))
+
+
+class TestExchangeCodec:
+    def test_off_resolves_none(self):
+        from dampr_tpu.parallel import exchange
+        settings.exchange_codec = "off"
+        assert exchange.wire_codec() is None
+
+    def test_unknown_resolves_none(self):
+        from dampr_tpu.parallel import exchange
+        settings.exchange_codec = "definitely-not-a-codec"
+        assert exchange.wire_codec() is None
+
+    def test_auto_never_picks_deflate(self):
+        from dampr_tpu.parallel import exchange
+        settings.exchange_codec = "auto"
+        c = exchange.wire_codec()
+        assert c is None or c.name in ("zstd", "lz4")
+
+    def test_roundtrip(self):
+        # Explicit selection exercises the wire framing even in builds
+        # without zstd/lz4 (where auto deliberately resolves to off).
+        from dampr_tpu.parallel import exchange
+        settings.exchange_codec = "zlib"
+        c = exchange.wire_codec()
+        assert c is not None
+        data = bytes(range(256)) * 512
+        wire = c.compress(data)
+        assert len(wire) < len(data)
+        assert bytes(codecs.decompress(c.cid, wire)) == data
+
+    def test_blob_exchange_compresses_and_delivers_exactly(self, mesh8):
+        from dampr_tpu.parallel import exchange, mesh_blob_exchange
+        settings.exchange_codec = "zlib"
+        rng = random.Random(5)
+        blobs = {}
+        for s in range(8):
+            for d in range(8):
+                if (s + d) % 3 == 0:
+                    blobs[(s, d)] = bytes(
+                        [rng.randrange(4)] * (1000 + s * 100 + d))
+        raw0, wire0 = exchange.codec_raw_bytes, exchange.codec_wire_bytes
+        out = mesh_blob_exchange(mesh8, blobs)
+        assert out == blobs  # decode restores every route byte-for-byte
+        raw_d = exchange.codec_raw_bytes - raw0
+        wire_d = exchange.codec_wire_bytes - wire0
+        assert raw_d == sum(len(b) for b in blobs.values())
+        assert 0 < wire_d < raw_d  # highly repetitive payloads shrink
+
+    def test_blob_exchange_codec_off_is_identity(self, mesh8):
+        from dampr_tpu.parallel import exchange, mesh_blob_exchange
+        settings.exchange_codec = "off"
+        blobs = {(0, 7): bytes(range(256)) * 100, (3, 3): b"x"}
+        raw0 = exchange.codec_raw_bytes
+        out = mesh_blob_exchange(mesh8, blobs)
+        assert out == blobs
+        assert exchange.codec_raw_bytes == raw0  # codec never engaged
+
+
+class TestFrameReadTiming:
+    def test_read_seconds_are_attempt_scoped(self, tmp_path, monkeypatch):
+        """A transient spill_read retry must not fold the failed attempt
+        or its backoff sleep into the per-frame read seconds (the spill
+        throughput metric would inflate on every flaky read)."""
+        from dampr_tpu.io import frames
+
+        p = str(tmp_path / "t.frames")
+        with open(p, "wb") as f:
+            w = frames.FrameWriter(f, codecs.resolve("zlib"))
+            w.add_frame(b"payload-bytes" * 100, records=1)
+            w.close()
+
+        monkeypatch.setattr(settings, "io_retries", 2)
+        monkeypatch.setattr(faults, "backoff", lambda attempt, rng=None: 0.25)
+        faults.install(faults.FaultPlan("spill_read:nth=1,kind=transient"))
+        r = frames.FrameReader(p)
+        try:
+            t0 = time.perf_counter()
+            payload, secs = r._read_frame_timed(0)
+            wall = time.perf_counter() - t0
+        finally:
+            r.close()
+            faults.clear()
+        assert bytes(payload) == b"payload-bytes" * 100
+        assert wall >= 0.25  # the retry really slept the backoff
+        assert secs < 0.2, (
+            "read seconds {:.3f} include the retry backoff".format(secs))
