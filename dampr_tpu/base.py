@@ -139,10 +139,14 @@ def _shared_instance_deepcopy(self, memo):
             seen = key in _share_warned
             _share_warned.add(key)
         if not seen:
-            log.warning(
+            from .obs import log as _obslog
+
+            _obslog.warn(
+                "shared-state-udf",
                 "%s holds a stateful callable object whose state cannot "
                 "be deep-copied (%s); the instance is SHARED across "
-                "concurrent jobs and must be thread-safe", key, e)
+                "concurrent jobs and must be thread-safe", key, e,
+                logger=log, type=key)
         return self
 
 

@@ -29,6 +29,15 @@ canonical workloads run from an installed package without a repo checkout.
   serialization, fold associativity, jax traceability); ``--json``
   emits the machine report (``docs/lint_schema.json``).  See
   :mod:`dampr_tpu.analyze.lint` and ``docs/analysis.md``.
+- ``dampr-tpu-sentry`` — regression sentry over the long-horizon
+  telemetry store (MAD anomaly detection per plan fingerprint);
+  ``--strict`` exits nonzero on a detected regression — the perf-gate
+  CI contract.  See :mod:`dampr_tpu.obs.sentry`.
+- ``dampr-tpu-top``    — live terminal dashboard polling every rank's
+  ``/metrics`` endpoint (``settings.metrics_port``); ``--once --json``
+  for scripts.  See :mod:`dampr_tpu.obs.top`.
+- ``dampr-tpu-history`` — inspect/GC/vacuum the run-history corpora
+  under the scratch root.  See :mod:`dampr_tpu.obs.history`.
 
 ``dampr-tpu-wc`` / ``dampr-tpu-tfidf`` take ``--progress`` for the live
 in-run status line (``settings.progress``) and ``--explain`` to print the
@@ -163,6 +172,30 @@ def lint():
     raise SystemExit(main())
 
 
+def sentry():
+    """Regression sentry over the telemetry store (see
+    dampr_tpu.obs.sentry)."""
+    from .obs.sentry import main
+
+    raise SystemExit(main())
+
+
+def top():
+    """Live fleet dashboard over per-rank /metrics endpoints (see
+    dampr_tpu.obs.top)."""
+    from .obs.top import main
+
+    raise SystemExit(main())
+
+
+def history_cli():
+    """Run-history corpus inspection/maintenance (see
+    dampr_tpu.obs.history)."""
+    from .obs.history import main
+
+    raise SystemExit(main())
+
+
 def _report_crashdump(dump):
     """Describe a flight-recorder crash dump on stderr (the non-zero
     exit's why).  Rank-attributed: a fleet run's dump names which rank
@@ -212,6 +245,11 @@ def stats():
                          "into one Perfetto timeline and print the fleet "
                          "section (per-rank totals, exchange matrices, "
                          "per-step skew, straggler)")
+    ap.add_argument("--log", nargs="?", const=20, type=int, default=None,
+                    metavar="N",
+                    help="render the newest N structured log events "
+                         "(default 20) from the run's events.jsonl "
+                         "(settings.log_level / DAMPR_TPU_LOG)")
     args = ap.parse_args()
 
     from .obs import export, flightrec
@@ -245,6 +283,18 @@ def stats():
                                   else args.run)
         if section is not None:
             summary["fleet"] = section
+    log_tail = None
+    if args.log is not None:
+        from .obs import log as obslog
+
+        # The stream lives next to stats.json; fall back to resolving
+        # the run name when the stats path came from elsewhere.
+        cand = (os.path.join(os.path.dirname(path), obslog.FILE)
+                if path else None)
+        log_tail = obslog.tail(cand if cand and os.path.isfile(cand)
+                               else args.run, n=args.log)
+        if args.json:
+            summary = dict(summary, log_tail=log_tail)
     if args.prom:
         from .obs import promtext
 
@@ -289,6 +339,15 @@ def stats():
         else:
             print()
             print(export.format_series(export.load_series(tf)))
+        pipe_view = export.format_pipeline_series(summary)
+        if pipe_view:
+            print()
+            print(pipe_view)
+    if args.log is not None and not args.json and not args.prom:
+        from .obs import log as obslog
+
+        print()
+        print(obslog.format_tail(log_tail))
     if dump is not None:
         for d in dumps:
             _report_crashdump(d)

@@ -28,6 +28,8 @@ import gzip
 import logging
 import zlib
 
+from ..obs import log as _obslog
+
 log = logging.getLogger("dampr_tpu.io.codecs")
 
 RAW, ZLIB, GZIP, LZ4, ZSTD = 0, 1, 2, 3, 4
@@ -42,7 +44,7 @@ _warned = set()
 def _warn_once(key, msg, *args):
     if key not in _warned:
         _warned.add(key)
-        log.warning(msg, *args)
+        _obslog.warn("codec-fallback", msg, *args, logger=log, codec=key)
 
 
 class Codec(object):

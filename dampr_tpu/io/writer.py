@@ -34,6 +34,7 @@ import threading
 import time
 
 from .. import faults as _faults
+from ..obs import log as _obslog
 from ..obs import trace as _trace
 from . import frames
 
@@ -238,8 +239,10 @@ class SpillWriterPool(object):
         for t in self._threads:
             t.join(timeout=5.0)
             if t.is_alive():
-                log.warning(
+                _obslog.warn(
+                    "writer-pool-stuck",
                     "spill writer thread %s did not stop within 5.0s at "
                     "shutdown; abandoning it (daemon) — a wedged codec "
-                    "or disk write is still in flight", t.name)
+                    "or disk write is still in flight", t.name,
+                    logger=log, thread=t.name)
         self._threads = []

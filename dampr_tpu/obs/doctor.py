@@ -747,6 +747,49 @@ def diagnose(run):
                                                 run_settings=run_settings),
             })
 
+    # -- long-horizon regressions (obs/sentry over the telemetry store) ------
+    sentry_section = None
+    if settings.sentry_window > 0:
+        from . import sentry as _sentry
+
+        sfindings = summary.get("sentry")
+        if sfindings is None:
+            sfindings = _sentry.check_run(summary.get("run"),
+                                          summary=summary)
+        if sfindings:
+            sentry_section = {"findings": sfindings,
+                              "window": _sentry.effective_window(),
+                              "threshold": _sentry.effective_threshold()}
+        for sf in sfindings or ():
+            sugs = []
+            if sf.get("setting"):
+                cur = (run_settings[sf["setting"]]
+                       if sf["setting"] in run_settings
+                       else getattr(settings, sf["setting"], None))
+                sug = {"setting": sf["setting"],
+                       "current": cur if _jsonable(cur) else str(cur),
+                       "suggested": None,
+                       "why": sf.get("why") or ""}
+                if sf.get("env"):
+                    sug["env"] = sf["env"]
+                sugs.append(sug)
+            findings.append({
+                "stage": None,
+                "bottleneck": "regression",
+                "impact_seconds": 0.0,
+                "severity": ("high" if abs(sf.get("z") or 0)
+                             >= 2 * (sf.get("threshold") or 1)
+                             else "medium"),
+                "evidence": "{} regressed against its {}-run baseline: "
+                            "{:g} vs median {:g} ({:+.1f} robust sigma, "
+                            "plan {})".format(
+                                sf.get("metric"), sf.get("window"),
+                                sf.get("value"), sf.get("median"),
+                                sf.get("z") or 0.0,
+                                sf.get("fingerprint")),
+                "suggestions": sugs,
+            })
+
     findings.sort(key=lambda f: -(f.get("impact_seconds") or 0.0))
     for rank, f in enumerate(findings, 1):
         f["rank"] = rank
@@ -785,6 +828,8 @@ def diagnose(run):
     }
     if fleet_report is not None:
         report["fleet"] = fleet_report
+    if sentry_section is not None:
+        report["sentry"] = sentry_section
     if fault_section is not None:
         report["faults"] = fault_section
     if summary.get("mitigation"):

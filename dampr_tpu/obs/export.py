@@ -255,6 +255,49 @@ def format_series(series):
     return "\n".join(lines)
 
 
+def format_pipeline_series(summary):
+    """The streamed-edge view of ``--series``: per-stage queue-depth
+    sparklines from ``stats()["pipeline"]["queue_depth_series"]`` (the
+    folder-side backlog each streamed edge carried over time), the
+    stall/overlap bottom line, and the exchange overlap counters.
+    Returns "" when the run streamed nothing (staged execution)."""
+    pipe = summary.get("pipeline") or {}
+    series = pipe.get("queue_depth_series") or []
+    lines = []
+    if series:
+        by_sid = {}
+        for sid, _t, nbytes in series:
+            by_sid.setdefault(sid, []).append(nbytes)
+        lines.append("streamed-edge queue depth (bytes):")
+        for sid in sorted(by_sid):
+            vals = by_sid[sid]
+            lines.append(
+                "  stage {:<3} {:>9} peak {:>9} last  {}".format(
+                    sid, _mb(max(vals)), _mb(vals[-1]),
+                    _sparkline(vals)))
+    if pipe.get("executed") or pipe.get("degraded"):
+        lines.append(
+            "pipeline: executed={} degraded={} overlap={:.2f}s "
+            "({:.0%} of fold) stall={:.2f}s queue_peak={}".format(
+                pipe.get("executed", 0), pipe.get("degraded", 0),
+                pipe.get("overlap_seconds", 0.0),
+                pipe.get("overlap_fraction", 0.0),
+                pipe.get("stall_seconds", 0.0),
+                _mb(pipe.get("queue_peak_bytes", 0))))
+    ex = (summary.get("mesh") or {}).get("exchange") or {}
+    if ex.get("steps"):
+        lines.append(
+            "exchange: steps={} bytes={} peak_inflight={}".format(
+                ex.get("steps", 0), _mb(ex.get("bytes", 0)),
+                _mb(ex.get("peak_inflight_bytes", 0))))
+    ov = summary.get("overlap") or {}
+    if ov.get("windows"):
+        lines.append(
+            "overlap: windows={} stall_fraction={:.3f}".format(
+                ov.get("windows", 0), ov.get("stall_fraction", 0.0)))
+    return "\n".join(lines)
+
+
 def _mb(n):
     return "{:.1f} MB".format(n / 1e6)
 

@@ -68,6 +68,11 @@ class FlightRecorder(object):
         self.epoch = time.perf_counter()
         self.wall_start = time.time()
         self._ring = collections.deque(maxlen=self.capacity)
+        #: Bounded tail of structured WARN+ log records (obs.log mirrors
+        #: them here), flushed as ``otherData.log`` — a crashdump names
+        #: the operational events that preceded the death, not just the
+        #: span/sample timeline.
+        self._log = collections.deque(maxlen=self.capacity)
         self.drops = 0  # best-effort (unlocked): ring evictions
         self.flush_count = 0
         self.path = None
@@ -85,6 +90,11 @@ class FlightRecorder(object):
         if len(ring) >= self.capacity:
             self.drops += 1
         ring.append(("sample", t_abs, vals))
+
+    def record_log(self, rec):
+        """One structured log record (a dict per docs/trace_schema.json's
+        ``otherData.log`` items) into the bounded log tail."""
+        self._log.append(rec)
 
     def __len__(self):
         return len(self._ring)
@@ -169,6 +179,9 @@ class FlightRecorder(object):
                     "crash": crash,
                 },
             }
+            log_tail = list(self._log)
+            if log_tail:
+                doc["otherData"]["log"] = log_tail
             rank = proc.get("process_id", 0)
             tdir = _export.run_trace_dir(self.run, rank=rank)
             os.makedirs(tdir, exist_ok=True)

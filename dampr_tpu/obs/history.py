@@ -50,7 +50,7 @@ log = logging.getLogger("dampr_tpu.obs.history")
 #: line and upgrade it in memory (:func:`upgrade`) — an old corpus
 #: degrades to thinner features, never to an empty history.
 SCHEMA_PREFIX = "dampr-tpu-history/"
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 SCHEMA = SCHEMA_PREFIX + str(SCHEMA_VERSION)
 FILE = "history.jsonl"
 
@@ -82,6 +82,11 @@ def upgrade(rec):
                 st.setdefault("shuffle_target", None)
         rec.setdefault("settings", {})
         rec.setdefault("throughput", {})
+    if v < 3:
+        # v2 -> v3: the "health" block (retries/quarantine/skew/reuse —
+        # the regression sentry's inputs) defaults empty; the sentry
+        # treats a missing metric as "no sample", never as zero.
+        rec.setdefault("health", {})
     return rec
 
 _append_lock = threading.Lock()
@@ -122,6 +127,32 @@ def _settings_snapshot():
     snap["metrics_interval_ms"] = settings.metrics_interval_ms
     snap["spill_codec"] = str(settings.spill_codec)
     return snap
+
+
+def _health_section(summary):
+    """The v3 run-health scalars from a finalized summary.  Only keys
+    with a real sample land — the sentry must distinguish "feature off"
+    from "measured zero"."""
+    out = {}
+    faults = summary.get("faults") or {}
+    if "retries" in faults:
+        out["retries"] = faults.get("retries")
+    if "quarantined" in faults:
+        q = faults.get("quarantined")
+        out["quarantined"] = len(q) if isinstance(q, (list, dict)) else q
+    skew = (summary.get("fleet") or {}).get("skew") or {}
+    mit = summary.get("mitigation") or {}
+    late = skew.get("late_ratio")
+    if late is None:
+        late = mit.get("last_late_ratio")
+    if late is not None:
+        out["late_ratio"] = late
+    reuse = summary.get("reuse") or {}
+    hits, misses = reuse.get("hits"), reuse.get("misses")
+    if isinstance(hits, int) and isinstance(misses, int) \
+            and hits + misses > 0:
+        out["reuse_hit_rate"] = round(hits / float(hits + misses), 4)
+    return out
 
 
 def compact_record(summary):
@@ -166,6 +197,9 @@ def compact_record(summary):
         },
         "io_wait_fraction": (summary.get("io") or {}).get(
             "io_wait_fraction"),
+        # Run-health scalars (v3) — what the regression sentry trends:
+        # fault absorption, straggler skew, and cross-run reuse yield.
+        "health": _health_section(summary),
         "settings": _settings_snapshot(),
     }
     proc = summary.get("process") or {}
@@ -342,3 +376,159 @@ def synthesize(records):
             corpus_path(newest.get("run")), n),
         "history_entries": n,
     }
+
+
+# -- corpus maintenance CLI (dampr-tpu-history) -----------------------------
+
+def _iter_corpora():
+    """Every (run_name, corpus_path) under the scratch root."""
+    root = settings.scratch_root
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return
+    for name in names:
+        path = os.path.join(root, name, FILE)
+        if os.path.isfile(path):
+            yield name, path
+
+
+def vacuum(path, cap=None):
+    """Rewrite one corpus in place: drop invalid lines, upgrade every
+    survivor to the current schema on disk, keep the newest ``cap``
+    (``settings.history_entries`` by default).  Returns (kept, dropped).
+    Same durability discipline as compaction: tmp + atomic replace."""
+    cap = settings.history_entries if cap is None else cap
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            lines = f.readlines()
+    except OSError:
+        return (0, 0)
+    recs = [r for r in (_valid_line(ln) for ln in lines) if r is not None]
+    if cap > 0:
+        recs = recs[-cap:]
+    for rec in recs:
+        # upgrade() already ran in _valid_line (stamping "v"); restamp
+        # the schema tag so the rewritten line IS a current-version line.
+        rec["schema"] = SCHEMA
+        rec["v"] = SCHEMA_VERSION
+    tmp = path + ".tmp"
+    with _append_lock:
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in recs:
+                f.write(json.dumps(rec, sort_keys=True,
+                                   separators=(",", ":"), default=str))
+                f.write("\n")
+        os.replace(tmp, path)
+    return (len(recs), len(lines) - len(recs))
+
+
+def _fmt_record(rec):
+    tp = rec.get("throughput") or {}
+    return "  {ts:<20} v{v} fp={fp} wall={wall} mbps={mbps}{rank}".format(
+        ts=str(rec.get("ts", "?"))[:20], v=rec.get("v", "?"),
+        fp=rec.get("fingerprint", "?"),
+        wall=("{:.2f}s".format(rec["wall_seconds"])
+              if isinstance(rec.get("wall_seconds"), (int, float))
+              else "?"),
+        mbps=tp.get("mbps", "?"),
+        rank=(" rank={}".format(rec["rank"]) if rec.get("rank") else ""))
+
+
+def main(argv=None):
+    """``dampr-tpu-history``: inspect and maintain run-history corpora.
+
+    With no run name, lists every corpus under the scratch root.  With a
+    run name, lists its records (newest last); ``--fingerprint`` filters
+    to one plan shape.  ``--gc`` compacts to the retention cap and
+    ``--vacuum`` additionally drops invalid lines and rewrites old-schema
+    records at the current version.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dampr-tpu-history",
+        description="inspect / maintain dampr_tpu run-history corpora")
+    p.add_argument("run", nargs="?", help="run name (default: list all)")
+    p.add_argument("--list", action="store_true",
+                   help="list corpora under the scratch root")
+    p.add_argument("--fingerprint", metavar="F",
+                   help="only records with this plan fingerprint")
+    p.add_argument("--gc", action="store_true",
+                   help="compact to the newest history_entries records")
+    p.add_argument("--vacuum", action="store_true",
+                   help="gc + drop invalid lines + upgrade old records "
+                        "on disk")
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    if args.run:
+        targets = [(args.run, corpus_path(args.run))]
+        if not os.path.isfile(targets[0][1]):
+            print("no history corpus for run {!r} under {}".format(
+                args.run, settings.scratch_root))
+            return 1
+    else:
+        targets = list(_iter_corpora())
+
+    if args.vacuum or args.gc:
+        report = []
+        for name, path in targets:
+            if args.vacuum:
+                kept, dropped = vacuum(path)
+            else:
+                with _append_lock:
+                    _compact_if_over(path)
+                kept = sum(1 for ln in open(path, encoding="utf-8",
+                                            errors="replace")
+                           if _valid_line(ln) is not None)
+                dropped = 0
+            report.append({"run": name, "path": path,
+                           "kept": kept, "dropped": dropped})
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            for r in report:
+                print("{run}: kept {kept} record(s), dropped {dropped} "
+                      "({path})".format(**r))
+        return 0
+
+    if args.run:
+        recs = load(args.run)
+        if args.fingerprint:
+            recs = [r for r in recs
+                    if r.get("fingerprint") == args.fingerprint]
+        if args.json:
+            print(json.dumps(recs, indent=2, sort_keys=True, default=str))
+        else:
+            print("{} — {} record(s)".format(args.run, len(recs)))
+            for rec in recs:
+                print(_fmt_record(rec))
+        return 0
+
+    rows = []
+    for name, path in targets:
+        recs = load(name)
+        fps = sorted({r.get("fingerprint") for r in recs
+                      if r.get("fingerprint")})
+        rows.append({"run": name, "records": len(recs),
+                     "fingerprints": fps,
+                     "newest": recs[-1].get("ts") if recs else None,
+                     "path": path})
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True, default=str))
+    else:
+        if not rows:
+            print("no history corpora under {}".format(
+                settings.scratch_root))
+        for r in rows:
+            print("{run:<24} {records:>4} record(s)  {nfp} plan shape(s)"
+                  "  newest={newest}".format(
+                      nfp=len(r["fingerprints"]), **r))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(main())

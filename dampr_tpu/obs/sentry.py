@@ -1,0 +1,283 @@
+"""Regression sentry: MAD anomaly detection over the telemetry store.
+
+A perf regression that lands quietly — a config drift, a slower codec, a
+straggler that mitigation stopped absorbing — shows up as the newest
+telemetry point drifting away from its plan-fingerprint series.  The
+sentry formalizes "drifting away" with the robust z-score:
+
+    med   = median(baseline)                  # trailing window
+    mad   = median(|x - med|)                 # median absolute deviation
+    scale = 1.4826 * mad                      # ~sigma for normal data
+    z     = (value - med) / scale
+
+MAD (not mean/stdev) because a baseline of a handful of wall-clock
+samples routinely contains one noisy-neighbor outlier — the median pair
+shrugs it off where a stdev would inflate and mask the regression.  A
+flat baseline (mad == 0, common for counters that sit at 0) falls back
+to ``scale = max(|med| * 0.05, 1e-9)`` so a genuinely new nonzero value
+still trips and identical values never do.
+
+Per-metric direction (``timeseries.METRICS``) keeps the test one-sided:
+wall/spill/retries regress UP, throughput/reuse/residency regress DOWN
+— a run that got *faster* never alarms.
+
+Detection needs at least :data:`MIN_BASELINE` comparable points (same
+plan fingerprint, metric present); thinner series stay silent.  The
+runner's finalize hook runs the sentry warn-only (a run never fails on
+its own telemetry); ``dampr-tpu-sentry --strict`` and the perf-gate CI
+leg escalate findings to a nonzero exit.
+"""
+
+import json
+import logging
+import statistics
+
+from .. import settings
+from . import timeseries as _timeseries
+
+log = logging.getLogger("dampr_tpu.obs.sentry")
+
+#: Minimum comparable baseline points before the sentry will judge.
+MIN_BASELINE = 3
+
+#: metric -> (settings attr, env var, why) — the doctor-playbook-style
+#: knob pointer a finding names so the reader knows which dial moves the
+#: regressed metric.  Every attr must exist on ``dampr_tpu.settings``
+#: (pinned by test_sentry).
+METRIC_KNOBS = {
+    "wall_seconds": (
+        "max_memory_per_stage", "DAMPR_TPU_MEMORY_BUDGET",
+        "wall time regressions usually track spill/eviction pressure; "
+        "check the stage memory budget first"),
+    "mbps": (
+        "overlap_windows", "DAMPR_TPU_OVERLAP_WINDOWS",
+        "throughput drops when the producer lookahead stops covering "
+        "consumer stalls"),
+    "spill_bytes": (
+        "max_memory_per_stage", "DAMPR_TPU_MEMORY_BUDGET",
+        "growing spill volume means the working set stopped fitting the "
+        "stage budget"),
+    "retries": (
+        "io_retries", "DAMPR_TPU_IO_RETRIES",
+        "rising retry absorption points at a degrading disk/codec; the "
+        "retry budget is masking it"),
+    "quarantined": (
+        "max_quarantined", "DAMPR_TPU_MAX_QUARANTINED",
+        "more quarantined partitions means more data silently excluded "
+        "from results"),
+    "late_ratio": (
+        "mitigate", "DAMPR_TPU_MITIGATE",
+        "worsening straggler skew; speculative mitigation can re-absorb "
+        "it"),
+    "reuse_hit_rate": (
+        "reuse_budget_bytes", "DAMPR_TPU_REUSE_BUDGET",
+        "falling cross-run cache yield — the reuse budget may be "
+        "evicting still-hot prefixes"),
+    "device_fraction": (
+        "lower", "DAMPR_TPU_LOWER",
+        "compute is sliding off the accelerator back onto host fallback "
+        "paths"),
+    "handoff_fraction": (
+        "handoff", "DAMPR_TPU_HANDOFF",
+        "stage boundaries stopped staying device-resident and are "
+        "round-tripping through host spill"),
+}
+
+
+def effective_window():
+    return max(0, settings.sentry_window)
+
+
+def effective_threshold():
+    return settings.sentry_mad_threshold
+
+
+def detect(points, window=None, threshold=None):
+    """Judge the NEWEST point of one fingerprint series against the
+    trailing ``window`` points before it.  Returns a (possibly empty)
+    list of finding dicts, one per regressed metric::
+
+        {metric, value, median, mad, z, threshold, window, direction,
+         run, ts, fingerprint, setting, env, why}
+
+    ``points`` must already be one comparable series (same fingerprint,
+    oldest -> newest); thinner-than-MIN_BASELINE metrics stay silent.
+    """
+    window = effective_window() if window is None else window
+    threshold = effective_threshold() if threshold is None else threshold
+    if len(points) < 2 or window <= 0 or threshold <= 0:
+        return []
+    newest = points[-1]
+    trailing = points[:-1][-window:]
+    findings = []
+    for metric, direction in _timeseries.METRICS.items():
+        value = newest.get(metric)
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        baseline = [p[metric] for p in trailing
+                    if isinstance(p.get(metric), (int, float))
+                    and not isinstance(p.get(metric), bool)]
+        if len(baseline) < MIN_BASELINE:
+            continue
+        med = statistics.median(baseline)
+        mad = statistics.median(abs(x - med) for x in baseline)
+        scale = 1.4826 * mad
+        if scale <= 0:
+            # Flat baseline: allow 5% drift of the median before a unit
+            # of z; epsilon floor keeps an all-zero baseline judgeable.
+            scale = max(abs(med) * 0.05, 1e-9)
+        z = (value - med) / scale
+        bad = z > threshold if direction == "high" else z < -threshold
+        if not bad:
+            continue
+        knob = METRIC_KNOBS.get(metric)
+        findings.append({
+            "metric": metric,
+            "value": value,
+            "median": med,
+            "mad": mad,
+            "z": round(z, 2),
+            "threshold": threshold,
+            "window": len(baseline),
+            "direction": direction,
+            "run": newest.get("run"),
+            "ts": newest.get("ts"),
+            "fingerprint": newest.get("fingerprint"),
+            "setting": knob[0] if knob else None,
+            "env": knob[1] if knob else None,
+            "why": knob[2] if knob else None,
+        })
+    findings.sort(key=lambda f: -abs(f["z"]))
+    return findings
+
+
+def check_run(run_name, summary=None, window=None, threshold=None):
+    """Sentry verdict for a run name's NEWEST telemetry point (the one
+    the runner just appended).  Rebuilds the store from the history
+    corpus when it is missing but history exists (pre-telemetry
+    corpora).  ``summary`` narrows judgement to that run's fingerprint
+    when given.  Never raises; no data -> no findings."""
+    try:
+        points = _timeseries.load(run_name)
+        if not points:
+            from . import history as _hist
+
+            if _hist.load(run_name):
+                _timeseries.fold(run_name)
+                points = _timeseries.load(run_name)
+        if not points:
+            return []
+        fp = None
+        if summary is not None:
+            from . import history as _hist
+
+            fp = _hist.plan_fingerprint(
+                (summary.get("plan") or {}).get("stage_shapes") or [])
+        if fp is None:
+            fp = points[-1].get("fingerprint")
+        series = _timeseries.series(points, fingerprint=fp)
+        return detect(series, window=window, threshold=threshold)
+    except Exception:
+        log.debug("sentry check failed for %r", run_name, exc_info=True)
+        return []
+
+
+def format_findings(findings):
+    """Human lines for a findings list (the CLI / doctor rendering)."""
+    out = []
+    for f in findings:
+        arrow = "above" if f["direction"] == "high" else "below"
+        line = ("REGRESSION {metric}: {value:g} is {z:+.1f} robust "
+                "sigma {arrow} the baseline median {median:g} "
+                "(window={window} run(s), run={run})".format(
+                    arrow=arrow, **f))
+        out.append(line)
+        if f.get("setting"):
+            out.append("  knob: settings.{setting} ({env}) — {why}".format(
+                **f))
+    return out
+
+
+def main(argv=None):
+    """``dampr-tpu-sentry``: judge a run's newest telemetry point.
+
+    Warn-only by default (exit 0, findings printed); ``--strict`` exits
+    2 when any metric regressed — the perf-gate CI contract.  Exit 1
+    means no telemetry/history exists for the run at all.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dampr-tpu-sentry",
+        description="regression sentry over dampr_tpu run telemetry")
+    p.add_argument("run", help="run name (scratch-root corpus key)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero when a regression is detected")
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument("--window", type=int, default=None,
+                   help="baseline window (default: settings.sentry_window"
+                        " = DAMPR_TPU_SENTRY_WINDOW)")
+    p.add_argument("--threshold", type=float, default=None,
+                   help="robust z threshold (default: settings."
+                        "sentry_mad_threshold = DAMPR_TPU_SENTRY_MAD)")
+    p.add_argument("--fingerprint", metavar="F", default=None,
+                   help="judge this plan-shape series instead of the "
+                        "newest point's")
+    p.add_argument("--fold", action="store_true",
+                   help="rebuild the telemetry store from the history "
+                        "corpus first")
+    args = p.parse_args(argv)
+
+    if args.fold:
+        n = _timeseries.fold(args.run)
+        print("folded {} point(s) from the history corpus".format(n))
+    points = _timeseries.load(args.run)
+    if not points:
+        from . import history as _hist
+
+        if _hist.load(args.run):
+            _timeseries.fold(args.run)
+            points = _timeseries.load(args.run)
+    if not points:
+        print("no telemetry for run {!r} under {} (and no history "
+              "corpus to fold)".format(args.run, settings.scratch_root))
+        return 1
+
+    fp = args.fingerprint or points[-1].get("fingerprint")
+    series = _timeseries.series(points, fingerprint=fp)
+    findings = detect(series, window=args.window, threshold=args.threshold)
+
+    if args.json:
+        print(json.dumps({
+            "run": args.run,
+            "fingerprint": fp,
+            "points": len(series),
+            "window": (args.window if args.window is not None
+                       else effective_window()),
+            "threshold": (args.threshold if args.threshold is not None
+                          else effective_threshold()),
+            "findings": findings,
+        }, indent=2, sort_keys=True))
+    else:
+        print("sentry: run={} fingerprint={} series={} point(s)".format(
+            args.run, fp, len(series)))
+        if findings:
+            for line in format_findings(findings):
+                print(line)
+        elif len(series) <= MIN_BASELINE:
+            print("baseline too thin to judge "
+                  "(need >{} comparable points)".format(MIN_BASELINE))
+        else:
+            print("no regression: newest point within {:g} robust sigma "
+                  "of its baseline".format(
+                      args.threshold if args.threshold is not None
+                      else effective_threshold()))
+    if findings and args.strict:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(main())

@@ -54,6 +54,11 @@ class MetricsServer(object):
         #: (tests).  ``self.port`` is the live bound port after start().
         self.base_port = int(port)
         self.port = None
+        #: True when the per-rank port was taken and the endpoint bound
+        #: a fallback port instead (concurrent runs on one box); the
+        #: runner records the live port in ``stats()["endpoint"]`` so
+        #: scrapers and ``dampr-tpu-top`` can still find the rank.
+        self.fallback = False
         self._httpd = None
         self._thread = None
 
@@ -114,19 +119,49 @@ class MetricsServer(object):
             def log_message(self, fmt, *args):
                 log.debug("metrics endpoint: " + fmt, *args)
 
-        port = self.base_port
-        if port > 0:
+        from . import log as _obslog
+
+        requested = self.base_port
+        if requested > 0:
             # Per-rank offset: co-located ranks each get their own port
             # (rank 0 = the configured port, rank k = port + k).
-            port += self.rank
-        try:
-            self._httpd = http.server.ThreadingHTTPServer(
-                ("", port), Handler)
-        except OSError as e:
-            log.warning("metrics endpoint bind failed on port %d: %s "
-                        "(endpoint disabled for this run)", port, e)
+            requested += self.rank
+        # Port-collision fallback: when the per-rank port is taken
+        # (back-to-back runs racing teardown, or two fleets sharing one
+        # box and one base port), probe the next free ports ABOVE the
+        # fleet's block (base + num_processes..) instead of giving up —
+        # a second run's endpoint must not silently vanish, and it must
+        # not steal a sibling rank's expected port either.
+        candidates = [requested]
+        if requested > 0:
+            probe_base = self.base_port + max(1, int(self.num_processes
+                                                     or 1))
+            candidates += [p for p in range(probe_base, probe_base + 32)
+                           if p != requested]
+        err = None
+        for port in candidates:
+            try:
+                self._httpd = http.server.ThreadingHTTPServer(
+                    ("", port), Handler)
+                break
+            except OSError as e:
+                err = e
+        if self._httpd is None:
+            _obslog.warn(
+                "metrics-bind-failed",
+                "metrics endpoint bind failed on port %d (and %d fallback "
+                "probes): %s (endpoint disabled for this run)", requested,
+                len(candidates) - 1, err, logger=log, rank=self.rank)
             return None
         self.port = self._httpd.server_address[1]
+        self.fallback = requested > 0 and self.port != requested
+        if self.fallback:
+            _obslog.warn(
+                "metrics-port-fallback",
+                "metrics endpoint port %d was taken; rank %d bound the "
+                "next free port %d instead (recorded in stats)",
+                requested, self.rank, self.port, logger=log,
+                requested=requested, bound=self.port)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="dampr-tpu-metrics-http")

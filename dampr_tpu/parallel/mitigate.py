@@ -67,6 +67,7 @@ import time
 
 from .. import faults as _faults
 from .. import settings
+from ..obs import log as _obslog
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..obs.fleet import straggler_of
@@ -248,12 +249,14 @@ class MitigationController(object):
         self._consec_healthy = 0
         self._event_locked("engage", rank=rank,
                            late_ratio=round(ratio, 2))
-        log.warning(
+        _obslog.warn(
+            "mitigation-engaged",
             "mitigation ENGAGED: rank %s enters collective steps %.2fx "
             "later than the fleet average for %d consecutive windows — "
             "degrading collective exchanges in place (probe every %s "
             "skipped windows)", rank, ratio, self.after,
-            self.probe_every or "-")
+            self.probe_every or "-", logger=log, straggler=rank,
+            late_ratio=round(ratio, 2))
 
     def _disengage_locked(self):
         self.engaged = False
@@ -261,9 +264,11 @@ class MitigationController(object):
         self._skip_counter = 0
         self._consec_healthy = 0
         self._event_locked("disengage")
-        log.warning(
+        _obslog.warn(
+            "mitigation-disengaged",
             "mitigation DISENGAGED: %d consecutive healthy probe "
-            "window(s) — collective exchanges resume", self.after)
+            "window(s) — collective exchanges resume", self.after,
+            logger=log)
 
     def _downweight_locked(self, rank, ratio):
         w = max(0.25, min(0.75, 1.0 / ratio if ratio > 1.0 else 0.5))
@@ -271,10 +276,11 @@ class MitigationController(object):
         self._route_cache = None
         self._event_locked("downweight", rank=rank, weight=round(w, 2),
                            late_ratio=round(ratio, 2))
-        log.warning(
+        _obslog.warn(
+            "mitigation-downweight",
             "mitigation: rank %s stays pathological — partition share "
             "down-weighted to %.2f for the remainder of the run",
-            rank, w)
+            rank, w, logger=log, straggler=rank, weight=round(w, 2))
 
     def note_local_retry(self):
         """One transient retry absorbed on THIS rank (shared with the
@@ -299,13 +305,15 @@ class MitigationController(object):
             if not self.skip_safe:
                 if not self._warned_unsafe_skip:
                     self._warned_unsafe_skip = True
-                    log.warning(
+                    _obslog.warn(
+                        "mitigation-unsafe-skip",
                         "mitigation engaged but degrade-in-place is "
                         "DISABLED: settings.exchange_timeout_ms is 0, "
                         "so a skipped collective could hang unboundedly "
                         "if rank state ever diverged — arm the exchange "
                         "watchdog to enable window skipping (stealing/"
-                        "speculation/down-weighting stay active)")
+                        "speculation/down-weighting stay active)",
+                        logger=log)
                 return True
             self._skip_counter += 1
             if (self.probe_every > 0

@@ -40,6 +40,7 @@ from .parallel import mitigate as _mitigate
 from .blocks import Block, BlockBuilder
 from .dataset import BlockDataset, Chunker, Dataset, SinkDataset
 from .graph import GInput, GMap, GReduce, GSink
+from .obs import log as _obslog
 from .obs import metrics as _metrics
 from .obs import profile as _profile
 from .obs import trace as _trace
@@ -280,21 +281,24 @@ def _overlap_stream(items, store, size_of=None):
                 # reservation when it observes ``stop``, but an item it
                 # slips into the queue after our drain would otherwise
                 # leak its budget charge until process exit.
-                log.warning(
+                _obslog.warn(
+                    "overlap-producer-stuck",
                     "overlap producer thread %s did not stop within "
                     "5.0s at shutdown; draining in-flight windows in "
                     "the background (daemon thread abandoned)",
-                    thread.name)
+                    thread.name, logger=log, thread=thread.name)
                 deadline = time.perf_counter() + 5.0
                 while thread.is_alive() and time.perf_counter() < deadline:
                     drain()
                     thread.join(timeout=0.05)
                 if thread.is_alive():
-                    log.warning(
+                    _obslog.warn(
+                        "overlap-producer-stuck",
                         "overlap producer thread %s still alive after "
                         "drain grace; any window it produces past this "
                         "point leaks its budget reservation until the "
-                        "store is cleaned up", thread.name)
+                        "store is cleaned up", thread.name, logger=log,
+                        thread=thread.name, after_drain=True)
             # The producer may have slipped one reserved block into the
             # slot the first drain freed before it observed ``stop`` —
             # with the thread joined (or the grace above spent), a final
@@ -477,9 +481,10 @@ class _StreamFolder(object):
                         self._note_delta(mapping, replacement)
             except Exception:  # noqa: BLE001 - folding is an optimization;
                 #               originals stay registered, the run is fine
-                log.warning("early-fold worker failed; disabling folding "
-                            "for this stage (originals kept)",
-                            exc_info=True)
+                _obslog.warn("early-fold-error",
+                             "early-fold worker failed; disabling folding "
+                             "for this stage (originals kept)",
+                             logger=log, exc_info=True)
                 with self._cv:
                     self._disabled = True
                     self._cv.notify_all()
@@ -517,8 +522,9 @@ class _StreamFolder(object):
             # Wedged folder at shutdown: stop consuming its results (the
             # originals are still registered and correct) and let the
             # daemon thread release its reservations as it drains.
-            log.warning("early-fold worker did not drain within 60s; "
-                        "using unfolded mappings")
+            _obslog.warn("early-fold-stuck",
+                         "early-fold worker did not drain within 60s; "
+                         "using unfolded mappings", logger=log)
             with self._cv:
                 self._disabled = True
                 self._cv.notify_all()
@@ -1097,7 +1103,15 @@ class MTRunner(object):
         self.profiler = None
         # Live metrics endpoint (obs.serve, settings.metrics_port): one
         # stdlib HTTP thread per rank while the run is in flight.
+        # _endpoint_info survives the server's teardown so finalize can
+        # record the bound port (fallback included) in stats().
         self._metrics_server = None
+        self._endpoint_info = None
+        # Structured log stream (obs.log, settings.log_level): coded
+        # JSONL events to <run>/trace/events.jsonl, WARN+ mirrored into
+        # the flight recorder's crashdump tail.  None = every emit site
+        # is one None-check.
+        self.logstream = None
         # Per-run device-route accounting: snapshot of the exchange
         # module's cumulative per-device/per-route counters at run start,
         # differenced at finalize so stats() carries THIS run's matrix.
@@ -3206,6 +3220,29 @@ class MTRunner(object):
                 self.name, settings.flight_recorder_events)
             self.flightrec = rec
             _flightrec.start(rec)
+        lvl = settings.effective_log_level()
+        if lvl or rec is not None:
+            # Structured log stream: on-disk events.jsonl when a level is
+            # in force (explicit DAMPR_TPU_LOG, or the traced-run "info"
+            # default), recorder-only otherwise — an unstreamed metered
+            # run still gets a WARN+ tail in its crashdump.  Starts
+            # before the remaining obs pieces so THEIR warnings (port
+            # fallback, bind failure) land as coded events too.
+            from .parallel.mesh import rank_info
+
+            path = None
+            if lvl and settings.log_events_max > 0:
+                from .obs import export as _export
+
+                tdir = _export.run_trace_dir(self.name)
+                os.makedirs(tdir, exist_ok=True)
+                path = os.path.join(tdir, _obslog.FILE)
+            self.logstream = _obslog.LogStream(
+                self.name, rank=rank_info()[0], level=lvl or "warn",
+                path=path, recorder=rec)
+            _obslog.start(self.logstream)
+            _obslog.info("run-start", "run %s started", self.name,
+                         partitions=getattr(self, "n_partitions", None))
         if settings.trace:
             # Run-scoped engine timeline.  The tracer is process-global
             # while active (instrumentation sites are free functions);
@@ -3291,7 +3328,17 @@ class MTRunner(object):
         if self._mitigation is not None:
             _mitigate.stop(self._mitigation)
         if self._metrics_server is not None:
-            self._metrics_server.stop()
+            srv = self._metrics_server
+            if srv.port is not None:
+                # Survives the teardown: finalize records the LIVE port
+                # (fallback-shifted or not) in stats()["endpoint"].
+                self._endpoint_info = {
+                    "port": srv.port,
+                    "requested": (srv.base_port + srv.rank
+                                  if srv.base_port > 0 else srv.base_port),
+                    "fallback": srv.fallback,
+                }
+            srv.stop()
             self._metrics_server = None
 
     def _install_sigterm(self):
@@ -3367,6 +3414,16 @@ class MTRunner(object):
             # leaves a bounded timeline tail with the last gauge samples
             # (writer-pool queue state included) instead of nothing.
             self._run_failed = True
+            if self.logstream is not None:
+                # Terminal structured record BEFORE the crashdump flush,
+                # so the dump's log tail names the death.  Direct emit
+                # (not module error()): the exception is re-raised — a
+                # duplicate stdlib error line here would be noise.
+                self.logstream.emit(
+                    "error", "run-failed",
+                    "run {} failed: {}: {}".format(
+                        self.name, type(e).__name__, str(e)[:500]),
+                    data={"exception": type(e).__name__})
             if rec is not None:
                 if self._sampler is not None:
                     # One last snapshot so the dump's final samples show
@@ -3389,6 +3446,12 @@ class MTRunner(object):
                                    devtime.delta(epoch))
             except Exception:
                 log.warning("stats/trace finalize failed", exc_info=True)
+            finally:
+                # The structured stream outlives _stop_obs so finalize
+                # can stamp run-finish; close it last, no matter what.
+                if self.logstream is not None:
+                    _obslog.stop(self.logstream)
+                    self.logstream = None
 
     def _exchange_deltas(self):
         """THIS run's per-device sent/received bytes and (src, dst)
@@ -3690,6 +3753,20 @@ class MTRunner(object):
                 {s.stage_id: s.seconds for s in self.stats})
         if self.flightrec is not None and self.flightrec.path:
             summary["crashdump_file"] = self.flightrec.path
+        if self.logstream is not None:
+            if not self._run_failed:
+                self.logstream.emit(
+                    "info", "run-finish",
+                    "run {} finished in {:.3f}s".format(self.name, wall),
+                    data={"wall_seconds": round(wall, 3)})
+            # Where the postmortem log lives + how much of it survived
+            # the bound — stats.json's pointer into events.jsonl.
+            summary["log"] = self.logstream.summary()
+        if self._endpoint_info is not None:
+            # The /metrics port this rank ACTUALLY served on (fallback-
+            # shifted when the requested port was taken) — what the
+            # dashboard and the serve concurrency tests read back.
+            summary["endpoint"] = self._endpoint_info
         if self.tracer is not None:
             summary["spans"] = self.tracer.span_summary()
             # Critical-path verdicts: per-stage and whole-run dominant
@@ -3742,7 +3819,35 @@ class MTRunner(object):
             # accumulated telemetry plan/cost.py and doctor consume.
             from .obs import history as _history
 
-            _history.append(summary)
+            hpath = _history.append(summary)
+            proc = summary.get("process") or {}
+            if (hpath and settings.sentry_window > 0
+                    and not proc.get("process_id")):
+                # Long-horizon telemetry: fold this run into the compact
+                # per-fingerprint series (rank 0 only — sibling ranks'
+                # records are rank-tagged trail, not run-level points),
+                # then ask the sentry whether the newest point regressed
+                # against its trailing baseline.  Warn-only here: a
+                # finalized run must never fail on its own telemetry.
+                try:
+                    from .obs import sentry as _sentry
+                    from .obs import timeseries as _timeseries
+
+                    _timeseries.append_from_summary(summary)
+                    findings = _sentry.check_run(self.name, summary=summary)
+                    if findings:
+                        summary["sentry"] = findings
+                        if self.logstream is not None:
+                            for f in findings:
+                                self.logstream.emit(
+                                    "warn", "sentry-regression",
+                                    "{metric} regressed: {value:g} vs "
+                                    "baseline median {median:g} "
+                                    "(z={z:.1f}, window={window})".format(
+                                        **f),
+                                    data=f)
+                except Exception:
+                    log.warning("telemetry sentry failed", exc_info=True)
 
     def _run(self, outputs, cleanup=True):
         from . import resume as _resume

@@ -886,6 +886,52 @@ flight_recorder_events = int(os.environ.get(
 metrics_series_cap = int(os.environ.get(
     "DAMPR_TPU_METRICS_SERIES_CAP", "4096"))
 
+#: Structured log stream (dampr_tpu.obs.log): minimum level persisted to
+#: the run-scoped ``<run>/trace/events.jsonl`` event log — one of
+#: ``debug`` / ``info`` / ``warn`` / ``error``.  Empty (the default)
+#: writes no event file; traced runs still stream at ``info`` (see
+#: :func:`effective_log_level`) so every traced artifact set carries its
+#: event tail.  With the stream inactive every emit site is one
+#: module-global None-check (same contract as ``trace``/``profile``);
+#: WARN+ events always reach the stdlib logger regardless.
+log_level = os.environ.get("DAMPR_TPU_LOG", "").strip().lower()
+
+#: Bound on the structured event log: past this many lines
+#: ``events.jsonl`` is compacted to the newest entries (tmp + atomic
+#: rename, the ``history.jsonl`` durability contract).  0 disables the
+#: on-disk stream entirely (WARN+ still mirrors into the flight
+#: recorder ring).
+log_events_max = int(os.environ.get("DAMPR_TPU_LOG_EVENTS_MAX", "4096"))
+
+
+def effective_log_level():
+    """The structured-log level actually in force: the explicit
+    ``log_level``, or ``info`` for traced runs (a traced artifact set
+    should include its event tail), or "" = no on-disk event stream."""
+    if log_level:
+        return log_level
+    if trace:
+        return "info"
+    return ""
+
+
+#: Regression sentry (dampr_tpu.obs.sentry): trailing-window size for
+#: the MAD anomaly check over the per-fingerprint telemetry series —
+#: the newest point is judged against up to this many prior points of
+#: the same plan fingerprint (at least 3 required).  0 disables the
+#: finalize-time sentry check entirely (``dampr-tpu-sentry`` still
+#: works post-hoc with an explicit ``--window``).
+sentry_window = int(os.environ.get("DAMPR_TPU_SENTRY_WINDOW", "8"))
+
+#: Robust z-score threshold for the sentry: a metric whose deviation
+#: from the baseline window's median exceeds this many scaled MADs (in
+#: the metric's bad direction) is flagged as a regression.
+sentry_mad_threshold = float(os.environ.get("DAMPR_TPU_SENTRY_MAD", "3.5"))
+
+#: Live fleet dashboard (dampr_tpu.obs.top / ``dampr-tpu-top``): refresh
+#: cadence in milliseconds between endpoint polls.
+top_refresh_ms = int(os.environ.get("DAMPR_TPU_TOP_REFRESH_MS", "1000"))
+
 #: Partition-size threshold (bytes) above which a single-input reduce streams
 #: a k-way merge over hash-sorted runs instead of materializing the partition
 #: (groups then arrive in hash order, not key order).  None = use

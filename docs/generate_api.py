@@ -51,6 +51,10 @@ MODULES = [
     "dampr_tpu.obs.history",
     "dampr_tpu.obs.doctor",
     "dampr_tpu.obs.autotune",
+    "dampr_tpu.obs.log",
+    "dampr_tpu.obs.timeseries",
+    "dampr_tpu.obs.sentry",
+    "dampr_tpu.obs.top",
     "dampr_tpu.analyze",
     "dampr_tpu.analyze.props",
     "dampr_tpu.analyze.pickleprobe",

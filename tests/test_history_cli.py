@@ -1,0 +1,157 @@
+"""Run-history maintenance (dampr_tpu.obs.history v3 + the
+dampr-tpu-history CLI): the v2 -> v3 upgrade and the health block,
+vacuum's on-disk rewrite (invalid lines dropped, old records restamped
+at the current schema), GC to the retention cap, and the CLI verbs
+(--list, run listing, --fingerprint, --gc, --vacuum, --json).
+"""
+
+import json
+import os
+
+import pytest
+
+from dampr_tpu import settings
+from dampr_tpu.obs import history
+
+
+def _v2_record(i, fp="cafecafecafecafe"):
+    """A minimal valid v2-era corpus line (predates the health block)."""
+    return {
+        "schema": "dampr-tpu-history/2",
+        "run": "old-run", "ts": 1000.0 + i, "fingerprint": fp,
+        "wall_seconds": 1.0 + i / 10.0,
+        "stages": [{"shape": "scan>map", "spill_bytes": 0}],
+        "settings": {}, "throughput": {"mbps": 10.0},
+    }
+
+
+@pytest.fixture
+def scratch(tmp_path):
+    old = settings.scratch_root
+    settings.scratch_root = str(tmp_path)
+    yield tmp_path
+    settings.scratch_root = old
+
+
+def _write_corpus(name, records, extra_lines=()):
+    path = history.corpus_path(name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+        for line in extra_lines:
+            f.write(line)
+    return path
+
+
+class TestSchemaV3:
+    def test_upgrade_v2_adds_health(self):
+        rec = history.upgrade(_v2_record(0))
+        assert rec["v"] == 2
+        assert rec["health"] == {}
+
+    def test_upgrade_v1_chains_through_v3(self):
+        rec = {"schema": "dampr-tpu-history/1", "run": "r",
+               "stages": [{"shape": "s"}]}
+        up = history.upgrade(rec)
+        assert up["v"] == 1
+        assert up["stages"][0]["shuffle_target"] is None
+        assert up["health"] == {} and up["throughput"] == {}
+
+    def test_compact_record_health_block(self):
+        summary = {
+            "run": "r", "started_at": 1.0, "wall_seconds": 2.0,
+            "stages": [{"kind": "map", "jobs": 1, "records_out": 10,
+                        "bytes_out": 100, "spill_bytes": 0,
+                        "seconds": 1.0}],
+            "faults": {"retries": 2, "quarantined": [3]},
+            "reuse": {"hits": 3, "misses": 1},
+        }
+        rec = history.compact_record(summary)
+        assert rec["schema"] == history.SCHEMA
+        assert rec["health"]["retries"] == 2
+        assert rec["health"]["quarantined"] == 1
+        assert rec["health"]["reuse_hit_rate"] == pytest.approx(0.75)
+        # no skew/mitigation sample -> late_ratio absent, not zero
+        assert "late_ratio" not in rec["health"]
+
+    def test_health_section_empty_when_nothing_sampled(self):
+        assert history._health_section({"run": "r", "stages": []}) == {}
+
+
+class TestVacuum:
+    def test_vacuum_drops_garbage_and_restamps_on_disk(self, scratch):
+        path = _write_corpus(
+            "old-run", [_v2_record(i) for i in range(3)],
+            extra_lines=["torn {line\n", "\n",
+                         json.dumps({"schema": "foreign/9",
+                                     "stages": []}) + "\n"])
+        kept, dropped = history.vacuum(path)
+        assert (kept, dropped) == (3, 3)
+        with open(path) as f:
+            lines = [json.loads(ln) for ln in f]
+        assert len(lines) == 3
+        for rec in lines:  # rewritten AT the current version, on disk
+            assert rec["schema"] == history.SCHEMA
+            assert rec["v"] == history.SCHEMA_VERSION
+            assert rec["health"] == {}
+
+    def test_vacuum_respects_cap(self, scratch):
+        path = _write_corpus("old-run",
+                             [_v2_record(i) for i in range(10)])
+        kept, dropped = history.vacuum(path, cap=4)
+        assert kept == 4
+        recs = history.load("old-run")
+        assert [r["ts"] for r in recs] == [1006.0, 1007.0, 1008.0, 1009.0]
+
+
+class TestCLI:
+    def test_missing_run_exits_one(self, scratch, capsys):
+        assert history.main(["nonesuch"]) == 1
+        assert "no history corpus" in capsys.readouterr().out
+
+    def test_list_all_corpora(self, scratch, capsys):
+        _write_corpus("run-a", [_v2_record(0)])
+        _write_corpus("run-b", [_v2_record(0), _v2_record(1)])
+        assert history.main(["--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert {r["run"] for r in rows} == {"run-a", "run-b"}
+        by_run = {r["run"]: r for r in rows}
+        assert by_run["run-b"]["records"] == 2
+        assert by_run["run-a"]["fingerprints"] == ["cafecafecafecafe"]
+
+    def test_run_listing_and_fingerprint_filter(self, scratch, capsys):
+        _write_corpus("old-run",
+                      [_v2_record(0), _v2_record(1, fp="deadbeefdeadbeef"),
+                       _v2_record(2)])
+        assert history.main(["old-run"]) == 0
+        out = capsys.readouterr().out
+        assert "3 record(s)" in out and "v2" in out
+        assert history.main(["old-run", "--fingerprint",
+                             "deadbeefdeadbeef", "--json"]) == 0
+        recs = json.loads(capsys.readouterr().out)
+        assert len(recs) == 1 and recs[0]["ts"] == 1001.0
+
+    def test_gc_compacts_to_retention(self, scratch, capsys):
+        _write_corpus("old-run", [_v2_record(i) for i in range(8)])
+        old = settings.history_entries
+        settings.history_entries = 5
+        try:
+            assert history.main(["old-run", "--gc", "--json"]) == 0
+        finally:
+            settings.history_entries = old
+        report = json.loads(capsys.readouterr().out)
+        assert report[0]["kept"] == 5
+        assert len(history.load("old-run")) == 5
+
+    def test_vacuum_verb_over_all_corpora(self, scratch, capsys):
+        _write_corpus("run-a", [_v2_record(0)],
+                      extra_lines=["garbage\n"])
+        _write_corpus("run-b", [_v2_record(0)])
+        assert history.main(["--vacuum"]) == 0
+        out = capsys.readouterr().out
+        assert "run-a: kept 1 record(s), dropped 1" in out
+        assert "run-b: kept 1 record(s), dropped 0" in out
+        with open(history.corpus_path("run-a")) as f:
+            rec = json.loads(f.readline())
+        assert rec["schema"] == history.SCHEMA

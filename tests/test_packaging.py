@@ -21,12 +21,15 @@ class TestPackaging:
         scripts = meta["project"]["scripts"]
         assert set(scripts) == {"dampr-tpu-bench", "dampr-tpu-wc",
                                 "dampr-tpu-tfidf", "dampr-tpu-stats",
-                                "dampr-tpu-doctor"}
+                                "dampr-tpu-doctor", "dampr-tpu-lint",
+                                "dampr-tpu-sentry", "dampr-tpu-top",
+                                "dampr-tpu-history"}
 
     def test_console_entry_points_import(self):
         from dampr_tpu import cli
 
-        for fn in (cli.bench, cli.wc, cli.tf_idf, cli.stats, cli.doctor):
+        for fn in (cli.bench, cli.wc, cli.tf_idf, cli.stats, cli.doctor,
+                   cli.lint, cli.sentry, cli.top, cli.history_cli):
             assert callable(fn)
 
     def test_bench_driver_hook_is_thin_wrapper(self):

@@ -1,0 +1,279 @@
+"""Telemetry store + regression sentry (dampr_tpu.obs.timeseries /
+obs.sentry): MAD detection math (zero-MAD fallback, one-sidedness,
+thin-baseline silence), knob-pointer integrity, store durability
+(append/load/compaction/fold), the dampr-tpu-sentry CLI exit-code
+contract, and the doctor's schema-valid `regression` finding class over
+a real run trajectory with an injected 30% slowdown.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.obs import doctor, history, sentry, timeseries
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate_doctor = _load_tool("validate_doctor")
+
+with open(os.path.join(ROOT, "docs", "doctor_schema.json")) as _f:
+    DOCTOR_SCHEMA = json.load(_f)
+
+
+def _point(i, wall=10.0, fp="feedfacecafebeef", **extra):
+    p = {"schema": timeseries.SCHEMA, "run": "synth", "ts": 1000.0 + i,
+         "fingerprint": fp, "wall_seconds": wall, "mbps": 100.0 / wall}
+    p.update(extra)
+    return p
+
+
+HEALTHY = [_point(i, wall) for i, wall in
+           enumerate([10.0, 10.2, 9.9, 10.1, 10.0])]
+
+
+@pytest.fixture
+def scratch(tmp_path):
+    old = settings.scratch_root
+    settings.scratch_root = str(tmp_path / "scratch")
+    yield tmp_path
+    settings.scratch_root = old
+
+
+class TestDetect:
+    def test_injected_regression_trips(self):
+        pts = HEALTHY + [_point(9, wall=13.0)]  # +30%
+        findings = sentry.detect(pts, window=8, threshold=3.5)
+        metrics = {f["metric"] for f in findings}
+        assert "wall_seconds" in metrics, findings
+        f = next(f for f in findings if f["metric"] == "wall_seconds")
+        assert f["direction"] == "high" and f["z"] > 3.5
+        assert f["run"] == "synth" and f["window"] == 5
+        assert f["median"] == pytest.approx(10.0)
+        # knob pointer rides along
+        assert f["setting"] == "max_memory_per_stage"
+        assert f["env"] == "DAMPR_TPU_MEMORY_BUDGET"
+        # findings sorted most-severe first
+        assert [abs(x["z"]) for x in findings] == sorted(
+            (abs(x["z"]) for x in findings), reverse=True)
+
+    def test_healthy_newest_is_silent(self):
+        pts = HEALTHY + [_point(9, wall=10.05)]
+        assert sentry.detect(pts, window=8, threshold=3.5) == []
+
+    def test_one_sided_faster_never_alarms(self):
+        pts = HEALTHY + [_point(9, wall=5.0)]  # way FASTER
+        findings = sentry.detect(pts, window=8, threshold=3.5)
+        assert all(f["metric"] != "wall_seconds" for f in findings)
+        # ... and mbps doubled, which is the GOOD direction too
+        assert all(f["metric"] != "mbps" for f in findings)
+
+    def test_zero_mad_fallback(self):
+        """Flat baseline: identical newest stays silent, a clearly-new
+        value trips via the 5%-of-median scale."""
+        flat = [_point(i, wall=10.0) for i in range(5)]
+        assert sentry.detect(flat + [_point(9, wall=10.0)],
+                             window=8, threshold=3.5) == []
+        findings = sentry.detect(flat + [_point(9, wall=13.0)],
+                                 window=8, threshold=3.5)
+        assert any(f["metric"] == "wall_seconds" for f in findings)
+        # all-zero counter baseline: a first nonzero value still trips
+        zeros = [_point(i, wall=10.0, retries=0) for i in range(5)]
+        findings = sentry.detect(zeros + [_point(9, wall=10.0, retries=4)],
+                                 window=8, threshold=3.5)
+        assert any(f["metric"] == "retries" for f in findings)
+
+    def test_thin_baseline_stays_silent(self):
+        pts = HEALTHY[:2] + [_point(9, wall=13.0)]  # 2 < MIN_BASELINE
+        assert sentry.detect(pts, window=8, threshold=3.5) == []
+
+    def test_window_bounds_the_baseline(self):
+        old = [_point(i, wall=20.0) for i in range(10)]
+        recent = [_point(10 + i, wall=10.0 + 0.1 * i) for i in range(5)]
+        findings = sentry.detect(old + recent + [_point(99, wall=13.0)],
+                                 window=5, threshold=3.5)
+        f = next(f for f in findings if f["metric"] == "wall_seconds")
+        assert f["window"] == 5 and f["median"] < 11.0
+
+    def test_metric_knobs_point_at_real_settings(self):
+        assert set(sentry.METRIC_KNOBS) == set(timeseries.METRICS)
+        for metric, (attr, env, why) in sentry.METRIC_KNOBS.items():
+            assert hasattr(settings, attr), (metric, attr)
+            assert env.startswith("DAMPR_TPU_"), (metric, env)
+            assert why
+
+
+class TestStore:
+    def test_point_from_summary(self):
+        summary = {
+            "run": "r", "started_at": 1234.5, "wall_seconds": 2.0,
+            "totals": {"bytes_out": 8_000_000},
+            "stages": [{"spill_bytes": 1000}, {"spill_bytes": 2000}],
+            "plan": {"stage_shapes": [{"shape": "scan>map"},
+                                      {"shape": "fold"}]},
+            "faults": {"retries": 3, "quarantined": 1},
+            "device": {"device_fraction": 0.5, "handoff_bytes": 4_000_000},
+        }
+        p = timeseries.point_from_summary(summary)
+        assert p["schema"] == timeseries.SCHEMA and p["run"] == "r"
+        assert p["fingerprint"] == history.plan_fingerprint(
+            summary["plan"]["stage_shapes"])
+        assert p["wall_seconds"] == 2.0
+        assert p["mbps"] == pytest.approx(4.0)
+        assert p["spill_bytes"] == 3000
+        assert p["retries"] == 3 and p["quarantined"] == 1
+        assert p["device_fraction"] == 0.5
+        assert p["handoff_fraction"] == pytest.approx(0.5)
+        # a run with nothing trendable folds to None
+        assert timeseries.point_from_summary({"run": "r"}) is None
+
+    def test_point_from_history_skips_rank_tagged(self):
+        assert timeseries.point_from_history({"rank": 1, "run": "r"}) \
+            is None
+
+    def test_append_load_roundtrip_and_tolerance(self, scratch):
+        path = timeseries.append_point(_point(0))
+        assert path and os.path.isfile(path)
+        with open(path, "a") as f:
+            f.write("torn {garbage\n")
+            f.write(json.dumps({"schema": "other/1", "run": "synth",
+                                "fingerprint": "x"}) + "\n")
+        timeseries.append_point(_point(1))
+        pts = timeseries.load("synth")
+        assert [p["ts"] for p in pts] == [1000.0, 1001.0]
+
+    def test_retention_compaction(self, scratch):
+        old = settings.history_entries
+        settings.history_entries = 1  # cap = 16
+        try:
+            for i in range(40):
+                timeseries.append_point(_point(i))
+            pts = timeseries.load("synth")
+            assert len(pts) == 16
+            assert pts[-1]["ts"] == 1039.0  # newest survive
+        finally:
+            settings.history_entries = old
+
+    def test_series_groups_by_fingerprint(self):
+        pts = [_point(0), _point(1, fp="other"), _point(2)]
+        by_fp = timeseries.series(pts)
+        assert set(by_fp) == {"feedfacecafebeef", "other"}
+        one = timeseries.series(pts, fingerprint="feedfacecafebeef")
+        assert [p["ts"] for p in one] == [1000.0, 1002.0]
+        assert timeseries.series(pts, fingerprint="missing") == []
+
+
+class TestCLI:
+    def _write_store(self, points):
+        path = timeseries.store_path("synth")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            for p in points:
+                f.write(json.dumps(p, sort_keys=True) + "\n")
+
+    def test_strict_trips_on_regression(self, scratch, capsys):
+        self._write_store(HEALTHY + [_point(9, wall=13.0)])
+        assert sentry.main(["synth", "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "REGRESSION wall_seconds" in out
+        assert "run=synth" in out and "knob:" in out
+
+    def test_warn_only_exits_zero(self, scratch, capsys):
+        self._write_store(HEALTHY + [_point(9, wall=13.0)])
+        assert sentry.main(["synth"]) == 0
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_healthy_exits_zero_even_strict(self, scratch, capsys):
+        self._write_store(HEALTHY + [_point(9, wall=10.05)])
+        assert sentry.main(["synth", "--strict"]) == 0
+        assert "no regression" in capsys.readouterr().out
+
+    def test_missing_run_exits_one(self, scratch, capsys):
+        assert sentry.main(["nonesuch", "--strict"]) == 1
+        assert "no telemetry" in capsys.readouterr().out
+
+    def test_json_output(self, scratch, capsys):
+        self._write_store(HEALTHY + [_point(9, wall=13.0)])
+        assert sentry.main(["synth", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["run"] == "synth" and doc["points"] == 6
+        assert any(f["metric"] == "wall_seconds"
+                   for f in doc["findings"])
+
+
+class TestEndToEnd:
+    @pytest.fixture
+    def traced(self, tmp_path):
+        old = (settings.trace, settings.trace_dir, settings.scratch_root,
+               settings.sentry_window)
+        settings.trace = True
+        settings.trace_dir = str(tmp_path / "traces")
+        settings.scratch_root = str(tmp_path / "scratch")
+        settings.sentry_window = 8
+        yield tmp_path
+        (settings.trace, settings.trace_dir, settings.scratch_root,
+         settings.sentry_window) = old
+
+    def _run_once(self):
+        em = (Dampr.memory([(i % 13, i) for i in range(6000)])
+              .group_by(lambda kv: kv[0])
+              .reduce(lambda k, vs: sum(v[1] for v in vs))
+              .run("sentry-e2e"))
+        stats = em.stats()
+        em.delete()
+        return stats
+
+    def test_trajectory_and_doctor_regression_finding(self, traced):
+        """Five same-fingerprint runs build the baseline, an injected
+        30%-slower point must produce a schema-valid doctor
+        `regression` finding; the healthy trajectory stays clean."""
+        for _ in range(5):
+            stats = self._run_once()
+        pts = timeseries.load("sentry-e2e")
+        assert len(pts) >= 5, "runner did not feed the telemetry store"
+        assert len({p["fingerprint"] for p in pts}) == 1
+        # healthy trajectory: no findings (runs are near-identical)
+        assert sentry.check_run("sentry-e2e") == []
+
+        base = [p["wall_seconds"] for p in pts]
+        bad = dict(pts[-1], ts=(pts[-1]["ts"] or 0) + 1,
+                   wall_seconds=max(base) * 1.3 + 5.0)
+        timeseries.append_point(bad)
+        findings = sentry.check_run("sentry-e2e")
+        assert any(f["metric"] == "wall_seconds" for f in findings)
+
+        report = doctor.diagnose(
+            os.path.join(settings.trace_dir, "sentry-e2e", "trace"))
+        regress = [f for f in report["findings"]
+                   if f.get("bottleneck") == "regression"]
+        assert regress, report["findings"]
+        f = regress[0]
+        assert "wall_seconds" in f["evidence"]
+        assert f["severity"] in ("high", "medium")
+        assert f["suggestions"], f
+        sec = report.get("sentry")
+        assert sec and sec["findings"] and sec["window"] == 8, sec
+        problems = validate_doctor.validate(report, DOCTOR_SCHEMA)
+        assert not problems, problems
+
+    def test_check_run_folds_from_history(self, traced):
+        """A corpus that predates the telemetry store gets rebuilt from
+        history.jsonl on first check."""
+        for _ in range(4):
+            self._run_once()
+        store = timeseries.store_path("sentry-e2e")
+        os.remove(store)
+        assert sentry.check_run("sentry-e2e") == []  # fold, then silent
+        assert os.path.isfile(store), "check_run did not fold history"
+        assert len(timeseries.load("sentry-e2e")) >= 4

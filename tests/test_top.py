@@ -1,0 +1,206 @@
+"""Fleet dashboard + endpoint concurrency (dampr_tpu.obs.top /
+obs.serve / obs.promtext): exposition parsing, snapshot rows against a
+LIVE MetricsServer, the dead-rank marker and hang bound, the
+port-collision fallback (probed above the fleet block, recorded in
+stats()["endpoint"]), back-to-back run teardown, and label-value
+escaping per the Prometheus text spec.
+"""
+
+import json
+import time
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.obs import metrics, promtext, top
+from dampr_tpu.obs.metrics import Metrics
+from dampr_tpu.obs.serve import MetricsServer
+
+
+@pytest.fixture
+def live_server():
+    """A MetricsServer on an OS-assigned port with a live registry."""
+    reg = Metrics("top-test")
+    reg.gauge_set("run.stage", 2)
+    reg.gauge_set("writer.queue_depth", 5)
+    reg.gauge_set("store.bytes", 1_000_000)
+    reg.counter_add("mitigation.engagements", 3)
+    metrics.start(reg)
+    srv = MetricsServer(0, run_name="top-test", rank=0, num_processes=1)
+    assert srv.start() is not None
+    yield srv, reg
+    srv.stop()
+    metrics.stop(reg)
+
+
+class TestParseExposition:
+    def test_gauges_counters_labels_and_garbage(self):
+        text = "\n".join([
+            "# HELP dampr_tpu_run_stage current stage",
+            "# TYPE dampr_tpu_run_stage gauge",
+            'dampr_tpu_run_stage{run="r",rank="0"} 3',
+            "dampr_tpu_writer_queue_depth 7.5",
+            "dampr_tpu_mitigation_engagements_total 2",
+            "malformed-line-without-value",
+            "dampr_tpu_bad_value nan-ish-garbage x",
+            "",
+        ])
+        out = top.parse_exposition(text)
+        assert out["dampr_tpu_run_stage"] == 3.0
+        assert out["dampr_tpu_writer_queue_depth"] == 7.5
+        assert out["dampr_tpu_mitigation_engagements_total"] == 2.0
+        assert "malformed-line-without-value" not in out
+
+    def test_known_names_cover_real_exposition(self, live_server):
+        """Every name the dashboard maps must parse out of a real
+        render (catches silent renames of the exposition surface)."""
+        _, reg = live_server
+        text = promtext.render(reg, rank=0)
+        parsed = top.parse_exposition(text)
+        assert "dampr_tpu_run_stage" in parsed
+        assert "dampr_tpu_writer_queue_depth" in parsed
+        assert "dampr_tpu_store_bytes" in parsed
+        assert "dampr_tpu_mitigation_engagements_total" in parsed
+
+
+class TestSnapshot:
+    def test_live_rank_row(self, live_server):
+        srv, _ = live_server
+        rows = top.snapshot([srv.port], timeout=2.0)
+        row = rows[0]
+        assert row["alive"] is True and row["rank"] == 0
+        assert row["run"] == "top-test"
+        assert row["stage"] == 2.0
+        assert row["queue_depth"] == 5.0
+        assert row["mitigation_engagements"] == 3.0
+
+    def test_dead_rank_marker_and_no_hang(self, live_server):
+        srv, _ = live_server
+        # a port nobody serves: connection refused, not a hang
+        dead_port = srv.port + 17
+        t0 = time.monotonic()
+        rows = top.snapshot([srv.port, dead_port], timeout=1.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, "snapshot hung on the dead rank"
+        assert rows[0]["alive"] is True
+        assert rows[1] == {"rank": 1, "port": dead_port, "alive": False}
+        text = top.render(rows)
+        lines = text.splitlines()
+        assert "UP" in lines[1] and "DEAD" in lines[2]
+        # dead rows render placeholder cells, not stale numbers
+        assert "-" in lines[2]
+
+    def test_mbps_derived_from_store_bytes_delta(self, live_server):
+        srv, reg = live_server
+        rows = top.snapshot([srv.port], timeout=2.0)
+        reg.gauge_set("store.bytes", 5_000_000)
+        rows2 = top.snapshot([srv.port], prev_rows=rows, dt=2.0,
+                             timeout=2.0)
+        assert rows2[0]["mbps"] == pytest.approx(2.0)
+
+    def test_once_json_cli(self, live_server, capsys):
+        srv, _ = live_server
+        rc = top.main(["--ports", str(srv.port), "--once", "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ports"] == [srv.port]
+        assert doc["ranks"][0]["alive"] is True
+
+    def test_once_all_dead_exits_one(self, capsys):
+        rc = top.main(["--ports", "1", "--once", "--json"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ranks"][0]["alive"] is False
+
+    def test_no_ports_exits_one(self, capsys):
+        old = settings.metrics_port
+        settings.metrics_port = 0
+        try:
+            assert top.main(["--once"]) == 1
+        finally:
+            settings.metrics_port = old
+
+
+class TestServeConcurrency:
+    def test_port_collision_probes_above_fleet_block(self):
+        a = MetricsServer(0, rank=0, num_processes=1)
+        assert a.start() is not None
+        try:
+            b = MetricsServer(a.port, rank=0, num_processes=2)
+            assert b.start() is not None
+            try:
+                assert b.fallback is True
+                # fallback never steals a sibling rank's expected port:
+                # rank 1 of b's fleet would claim a.port + 1
+                assert b.port != a.port + 1
+                assert b.port >= a.port + 2
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+
+    def test_fallback_recorded_in_run_stats(self, tmp_path):
+        blocker = MetricsServer(0, rank=0, num_processes=1)
+        assert blocker.start() is not None
+        old = (settings.metrics_port, settings.scratch_root,
+               settings.trace_dir)
+        settings.metrics_port = blocker.port
+        settings.scratch_root = str(tmp_path / "scratch")
+        settings.trace_dir = str(tmp_path / "traces")
+        try:
+            em = (Dampr.memory(list(range(2000)))
+                  .map(lambda x: (x % 5, 1)).run("endpoint-fallback"))
+            ep = em.stats().get("endpoint")
+            em.delete()
+        finally:
+            (settings.metrics_port, settings.scratch_root,
+             settings.trace_dir) = old
+            blocker.stop()
+        assert ep, "run recorded no endpoint section"
+        assert ep["requested"] == blocker.port
+        assert ep["fallback"] is True and ep["port"] != blocker.port
+
+    def test_back_to_back_runs_rebind_cleanly(self, tmp_path):
+        """Sequential runs on one configured port: teardown must release
+        the socket so the second run binds WITHOUT fallback."""
+        probe = MetricsServer(0, rank=0, num_processes=1)
+        assert probe.start() is not None
+        free_port = probe.port
+        probe.stop()
+        old = (settings.metrics_port, settings.scratch_root,
+               settings.trace_dir)
+        settings.metrics_port = free_port
+        settings.scratch_root = str(tmp_path / "scratch")
+        settings.trace_dir = str(tmp_path / "traces")
+        endpoints = []
+        try:
+            for i in range(2):
+                em = (Dampr.memory(list(range(2000)))
+                      .map(lambda x: (x % 5, 1)).run("b2b-%d" % i))
+                endpoints.append(em.stats().get("endpoint"))
+                em.delete()
+        finally:
+            (settings.metrics_port, settings.scratch_root,
+             settings.trace_dir) = old
+        for ep in endpoints:
+            assert ep and ep["port"] == free_port, endpoints
+            assert ep["fallback"] is False, endpoints
+
+
+class TestPromtextEscaping:
+    def test_escape_label_value(self):
+        assert promtext.escape_label_value('a"b') == 'a\\"b'
+        assert promtext.escape_label_value("a\nb") == "a\\nb"
+        assert promtext.escape_label_value("a\\b") == "a\\\\b"
+        # backslash escapes first: no double-escaping of the others
+        assert promtext.escape_label_value('\\"') == '\\\\\\"'
+
+    def test_hostile_run_name_keeps_exposition_parseable(self):
+        reg = Metrics('evil"run\nname\\x')
+        reg.gauge_set("run.stage", 1)
+        text = promtext.render(reg, rank=0)
+        assert "\n\n" not in text.strip()
+        for line in text.splitlines():
+            assert line.startswith("#") or " " in line, line
+        parsed = top.parse_exposition(text)
+        assert parsed["dampr_tpu_run_stage"] == 1.0

@@ -21,6 +21,12 @@ Checks:
 4. **every env var is documented** — every ``DAMPR_TPU_*`` name used
    in the package source appears somewhere under ``docs/`` or in
    ``README.md``.
+5. **structured event codes form a closed set** — every code passed to
+   an ``obs.log`` emit site (``_obslog.debug/info/warn/error(...)`` or a
+   direct ``stream.emit("level", "code", ...)``) is declared in
+   :data:`dampr_tpu.obs.log.EVENT_CODES`, every declared code still has
+   an emit site (no dead registry entries), and every code appears
+   (backtick-quoted) in ``docs/observability.md``'s event table.
 
 Usage::
 
@@ -128,6 +134,42 @@ def check_env_docs(root, sources, errors):
                 "README.md".format(env, rel))
 
 
+_EVENT_RX = re.compile(
+    r"""_obslog\.(?:debug|info|warn|error)\(\s*\n?\s*['"]([a-z0-9-]+)['"]""")
+_EMIT_RX = re.compile(
+    r"""\.emit\(\s*\n?\s*['"](?:debug|info|warn|error)['"],\s*"""
+    r"""\n?\s*['"]([a-z0-9-]+)['"]""")
+
+
+def check_event_codes(root, sources, errors):
+    from dampr_tpu.obs import log as obslog
+
+    declared = set(obslog.EVENT_CODES)
+    used = {}
+    for rel, src in sources.items():
+        if rel.endswith(os.path.join("obs", "log.py")):
+            continue  # the registry/module itself, not an emit site
+        for rx in (_EVENT_RX, _EMIT_RX):
+            for m in rx.finditer(src):
+                used.setdefault(m.group(1), rel)
+    for code, rel in sorted(used.items()):
+        if code not in declared:
+            errors.append(
+                "event code {!r} (emitted in {}) not declared in "
+                "obs.log.EVENT_CODES".format(code, rel))
+    for code in sorted(declared - set(used)):
+        errors.append(
+            "EVENT_CODES declares {!r} but no package source emits it "
+            "(dead registry entry?)".format(code))
+    with open(os.path.join(root, "docs", "observability.md")) as f:
+        doc = f.read()
+    for code in sorted(declared):
+        if "`{}`".format(code) not in doc:
+            errors.append(
+                "event code {!r} undocumented in docs/observability.md"
+                .format(code))
+
+
 def run(root):
     sys.path.insert(0, root)
     errors = []
@@ -136,6 +178,7 @@ def run(root):
     check_span_kinds(root, sources, errors)
     check_fault_sites(root, errors)
     check_env_docs(root, sources, errors)
+    check_event_codes(root, sources, errors)
     return errors
 
 
@@ -150,7 +193,7 @@ def main(argv=None):
         print("{} violation(s)".format(len(errors)), file=sys.stderr)
         return 1
     print("repo lint OK (playbook knobs, span kinds, fault sites, "
-          "env docs)")
+          "env docs, event codes)")
     return 0
 
 
