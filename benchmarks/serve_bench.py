@@ -1,0 +1,250 @@
+"""Sustained-load benchmark for the serve daemon (docs/serve.md).
+
+N concurrent clients submit overlapping pipelines — V distinct suffix
+variants over one shared word-count prefix — against an in-process
+:class:`dampr_tpu.serve.ServeDaemon`.  Two measured legs over the SAME
+submission schedule:
+
+1. **cold** — every job submits with ``reuse="off"``: no in-flight
+   coalescing, no materialization cache; this is "N independent cold
+   runs" routed through the daemon's own dispatch machinery (same
+   process overhead, so the comparison isolates the reuse win).
+2. **served** — the daemon's native mode (``reuse="auto"`` resolves ON
+   in workers): identical in-flight submissions coalesce onto one run
+   and the shared prefix mounts from the cross-run cache.
+
+Headline ``value`` is the served leg's **requests/s**; the record also
+carries ``p50_s`` / ``p99_s`` request latency (lower-is-better — the
+CI gate reads them with ``--metric-key p99_s --direction lower``),
+the reuse hit count/rate, and ``speedup_vs_cold``.
+
+Correctness is asserted, not sampled: every client's served records
+must equal its variant's solo cache-off oracle run, and repeat
+submissions of one variant must return **byte-identical** result
+payloads (the daemon streams the worker's pickle verbatim).  A
+violation exits non-zero — like incremental_bench, this is a
+correctness witness first and a perf gate second.
+
+    python benchmarks/serve_bench.py --mb 4 --clients 3
+"""
+
+import _pathfix  # noqa: F401  (repo root onto sys.path)
+
+import argparse
+import json
+import operator
+import os
+import pickle
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "theta",
+         "kappa", "lambda", "sigma", "token", "frame", "spill", "merge"]
+
+
+def make_corpus(d, mb, nfiles=6):
+    os.makedirs(d, exist_ok=True)
+    per_file = int(mb * 1024 ** 2 / nfiles)
+    for i in range(nfiles):
+        with open(os.path.join(d, "part-{:04d}.txt".format(i)), "w") as f:
+            written, j = 0, i
+            while written < per_file:
+                row = " ".join(WORDS[(j + k * 3) % len(WORDS)]
+                               for k in range(9))
+                line = "{} doc{}\n".format(row, j % 257)
+                f.write(line)
+                written += len(line)
+                j += 1
+
+
+def build_variant(corpus_dir, variant):
+    """One tenant's pipeline: the shared word-count prefix (identical
+    across variants — the reusable materialization) plus a variant-
+    specific suffix.  The suffix lambda's default-arg capture gives each
+    variant a distinct plan fingerprint (identical submissions of ONE
+    variant still coalesce)."""
+    from dampr_tpu import Dampr
+
+    counts = (Dampr.text(corpus_dir)
+              .flat_map(lambda line: line.split())
+              .map(lambda w: (w, 1))
+              .fold_by(lambda kv: kv[0], value=lambda kv: kv[1],
+                       binop=operator.add))
+    return counts.map(lambda kv, v=variant: (kv[0], (v, kv[1])))
+
+
+def lint_pipelines():
+    """dampr-tpu-lint discovery hook (nothing runs)."""
+    return [("serve_bench", build_variant(__file__, 0))]
+
+
+def solo_oracle(corpus_dir, variant):
+    """The variant's cache-off in-process run — the correctness bar."""
+    from dampr_tpu import settings
+
+    old = settings.reuse
+    settings.reuse = "off"
+    try:
+        em = build_variant(corpus_dir, variant).run(
+            name="serve-bench-oracle-{}".format(variant))
+        return sorted(em.dataset.read())
+    finally:
+        settings.reuse = old
+
+
+def run_leg(client_cls, url, corpus_dir, schedule, reuse, timeout_s):
+    """Execute one submission schedule: ``schedule`` is a list of
+    (client_index, variant) pairs per client thread.  Returns
+    (wall_seconds, per-request latencies, rows, payload bytes by job)."""
+    latencies = []
+    rows = {}
+    payloads = {}
+    errors = []
+    lock = threading.Lock()
+
+    def one_client(ci, variants):
+        client = client_cls(url)
+        for v in variants:
+            plan = build_variant(corpus_dir, v)
+            t0 = time.time()
+            try:
+                job = client.submit(plan, tenant="tenant-{}".format(ci),
+                                    reuse=reuse)
+                row = job.wait(timeout_s=timeout_s)
+                body = job.result_bytes(timeout_s=timeout_s)
+            except Exception as e:
+                with lock:
+                    errors.append("client {} variant {}: {}".format(
+                        ci, v, e))
+                return
+            dt = time.time() - t0
+            with lock:
+                latencies.append(dt)
+                rows[job.id] = row
+                payloads.setdefault(v, []).append(body)
+
+    threads = [threading.Thread(target=one_client, args=(ci, variants))
+               for ci, variants in enumerate(schedule)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    if errors:
+        raise RuntimeError("; ".join(errors))
+    return wall, latencies, rows, payloads
+
+
+def percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mb", type=float, default=4.0,
+                    help="corpus size in MB (default 4)")
+    ap.add_argument("--clients", type=int, default=3,
+                    help="concurrent client threads (default 3)")
+    ap.add_argument("--jobs-per-client", type=int, default=3,
+                    help="submissions per client (default 3)")
+    ap.add_argument("--variants", type=int, default=None,
+                    help="distinct pipeline suffixes (default: clients)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="daemon worker slots (default 2)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-request wait deadline seconds")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="dampr-serve-bench-")
+    os.environ["DAMPR_TPU_SCRATCH"] = os.path.join(tmp, "scratch")
+    from dampr_tpu import settings
+
+    settings.scratch_root = os.path.join(tmp, "scratch")
+    from dampr_tpu.serve.client import ServeClient
+    from dampr_tpu.serve.daemon import ServeDaemon
+
+    corpus = os.path.join(tmp, "corpus")
+    make_corpus(corpus, args.mb)
+    nvariants = args.variants or args.clients
+    # Client i's schedule rotates through the variant set, so variants
+    # overlap across clients (the service's whole premise) and repeat
+    # within the run (coalesce + identical-rerun hits).
+    schedule = [[(ci + j) % nvariants for j in range(args.jobs_per_client)]
+                for ci in range(args.clients)]
+    total_jobs = args.clients * args.jobs_per_client
+
+    oracles = {v: solo_oracle(corpus, v) for v in range(nvariants)}
+
+    daemon = ServeDaemon(port=0, workers=args.workers,
+                         state_dir=os.path.join(tmp, "serve"))
+    if daemon.start() is None:
+        print("serve_bench: daemon bind failed", file=sys.stderr)
+        return 2
+    url = "http://127.0.0.1:{}".format(daemon.port)
+    try:
+        cold_wall, cold_lat, _rows, _payloads = run_leg(
+            ServeClient, url, corpus, schedule, "off", args.timeout)
+        wall, lat, rows, payloads = run_leg(
+            ServeClient, url, corpus, schedule, "auto", args.timeout)
+    finally:
+        daemon.stop()
+
+    # Correctness gate 1: served records match each variant's solo
+    # cache-off oracle.  Gate 2: repeat submissions of one variant got
+    # byte-identical payloads (verbatim-stream contract).
+    for v, bodies in payloads.items():
+        got = sorted(pickle.loads(bodies[0]))
+        if got != oracles[v]:
+            print("serve_bench: FAIL: variant {} served records diverge "
+                  "from the solo oracle".format(v), file=sys.stderr)
+            return 1
+        if any(b != bodies[0] for b in bodies[1:]):
+            print("serve_bench: FAIL: variant {} repeat submissions "
+                  "returned non-identical payload bytes".format(v),
+                  file=sys.stderr)
+            return 1
+
+    reuse_hits = sum(r.get("reuse_hits") or 0 for r in rows.values())
+    coalesced = sum(1 for r in rows.values()
+                    if r.get("state") == "done" and r.get("primary"))
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "metric": "serve-sustained",
+        # Headline: served-leg sustained throughput (higher is better;
+        # the same record also gates p99_s with --direction lower).
+        "value": round(total_jobs / wall, 4),
+        "direction": "higher",
+        "requests_per_s": round(total_jobs / wall, 4),
+        "p50_s": round(percentile(lat, 0.50), 4),
+        "p99_s": round(percentile(lat, 0.99), 4),
+        "cold_requests_per_s": round(total_jobs / cold_wall, 4),
+        "cold_p50_s": round(percentile(cold_lat, 0.50), 4),
+        "cold_p99_s": round(percentile(cold_lat, 0.99), 4),
+        "speedup_vs_cold": round(cold_wall / wall, 3),
+        "reuse_hits": reuse_hits,
+        "reuse_hit_rate": round(reuse_hits / float(total_jobs), 3),
+        "coalesced_jobs": coalesced,
+        "clients": args.clients,
+        "jobs_per_client": args.jobs_per_client,
+        "variants": nvariants,
+        "workers": args.workers,
+        "corpus_mb": args.mb,
+        "total_jobs": total_jobs,
+        "wall_seconds": round(wall, 3),
+        "cold_wall_seconds": round(cold_wall, 3),
+        "byte_exact": True,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,6 +196,15 @@ def history_cli():
     raise SystemExit(main())
 
 
+def serve():
+    """Multi-tenant pipeline service daemon (see dampr_tpu.serve and
+    docs/serve.md): accepts validated plan submissions over HTTP, runs
+    each in an isolated per-job worker, drains gracefully on SIGTERM."""
+    from .serve.daemon import main
+
+    raise SystemExit(main())
+
+
 def _report_crashdump(dump):
     """Describe a flight-recorder crash dump on stderr (the non-zero
     exit's why).  Rank-attributed: a fleet run's dump names which rank

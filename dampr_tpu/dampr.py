@@ -253,6 +253,19 @@ class PBase(object):
         """Shorthand for run() + read()."""
         return self.run(**kwargs).read(k)
 
+    def submit(self, url, tenant="default", **kwargs):
+        """Ship this composed pipeline to a ``dampr-tpu-serve`` daemon
+        instead of running it in-process; returns a
+        :class:`dampr_tpu.serve.RemoteJob` (``.wait()`` / ``.result()``
+        / ``.read()`` / ``.cancel()``).  The plan travels validated and
+        fingerprinted — an unpicklable capture fails fast client-side
+        with the coded ``DTA401`` diagnostic, and identical in-flight
+        submissions coalesce onto one run daemon-side.  See
+        docs/serve.md."""
+        from .serve.client import ServeClient
+
+        return ServeClient(url).submit(self, tenant=tenant, **kwargs)
+
 
 class _TopKBlocks(Mapper):
     """Per-chunk top-k candidate selection at block granularity: numeric

@@ -95,6 +95,18 @@ EVENT_CODES = {
     # -- telemetry plane -----------------------------------------------------
     "sentry-regression": "the regression sentry flagged a metric against "
                          "its per-fingerprint baseline window",
+    # -- serve daemon (dampr_tpu.serve) --------------------------------------
+    "serve-submit": "the serve daemon received a submission from a tenant",
+    "serve-admit": "a submission passed the admission gate and reserved "
+                   "its byte cost against the tenant budget",
+    "serve-reject": "a submission was refused at the door (wire error, "
+                    "validation failure, budget, queue depth, or drain)",
+    "serve-coalesce": "an identical in-flight fingerprint: the submission "
+                      "attached as a follower of the running primary",
+    "serve-evict": "retired job records past the retention bound were "
+                   "evicted from the daemon's job table",
+    "serve-drain": "the daemon began draining: finishing admitted jobs, "
+                   "rejecting new submissions",
 }
 
 

@@ -224,7 +224,63 @@ def render(rows, color=False):
     return "\n".join(lines)
 
 
-def _live_loop(ports, refresh_ms, timeout):
+# -- serve daemon jobs view -------------------------------------------------
+
+_JOB_COLS = (
+    ("job", "JOB", 6), ("tenant", "TENANT", 10), ("state", "STATE", 9),
+    ("queue_wait_s", "WAIT", 7), ("wall_s", "WALL", 7),
+    ("reuse_hits", "REUSE", 5), ("records", "RECS", 7),
+    ("coalesced", "COAL", 4), ("error", "ERROR", 24),
+)
+
+
+def scrape_jobs(url, timeout=1.0):
+    """One serve daemon's /jobs document, or None when it's down (same
+    liveness discipline as rank scrapes: bounded, never a raise)."""
+    try:
+        return json.loads(_fetch(url.rstrip("/") + "/jobs", timeout))
+    except Exception:
+        return None
+
+
+def _job_cell(row, key):
+    v = row.get(key)
+    if key in ("queue_wait_s", "wall_s"):
+        return "{:.2f}s".format(v) if isinstance(v, (int, float)) else "-"
+    if key == "error":
+        return str(v)[:24] if v else "-"
+    if v is None:
+        return "-"
+    return str(v)
+
+
+def render_jobs(doc):
+    """A /jobs document -> the daemon job table (tenant, state, queue
+    wait, reuse hits — the serve-side rows next to the per-rank ones)."""
+    if doc is None:
+        return "serve daemon: DEAD (no /jobs answer)"
+    lines = ["serve daemon {} — {} job(s){}".format(
+        doc.get("daemon", "?"), len(doc.get("jobs") or ()),
+        " — DRAINING" if doc.get("draining") else "")]
+    lines.append("  ".join("{:<{w}}".format(title, w=w)
+                           for _, title, w in _JOB_COLS))
+    for row in doc.get("jobs") or ():
+        lines.append("  ".join(
+            "{:<{w}}".format(_job_cell(row, key), w=w)
+            for key, _, w in _JOB_COLS))
+    tenants = doc.get("tenants") or {}
+    if tenants:
+        parts = []
+        for name, st in sorted(tenants.items()):
+            parts.append("{}: {} queued, {}/{} reserved".format(
+                name, st.get("queued", 0),
+                _mb(st.get("reserved_bytes", 0)),
+                _mb(st.get("budget_bytes", 0))))
+        lines.append("tenants: " + "; ".join(parts))
+    return "\n".join(lines)
+
+
+def _live_loop(ports, refresh_ms, timeout, jobs_url=None):
     interval = max(0.05, refresh_ms / 1000.0)
     prev_rows, prev_t = None, None
     try:
@@ -242,6 +298,9 @@ def _live_loop(ports, refresh_ms, timeout):
                     alive, len(rows),
                     ",".join(str(p) for p in ports), interval))
             sys.stdout.write(render(rows, color=True) + "\n")
+            if jobs_url:
+                sys.stdout.write("\n" + render_jobs(
+                    scrape_jobs(jobs_url, timeout=timeout)) + "\n")
             sys.stdout.flush()
             prev_rows, prev_t = rows, t0
             time.sleep(max(0.0, interval - (time.monotonic() - t0)))
@@ -274,6 +333,11 @@ def main(argv=None):
     p.add_argument("--timeout", type=float, default=None,
                    help="per-request timeout seconds (default: bounded "
                         "by the refresh interval, max 1s)")
+    p.add_argument("--jobs", default=None, metavar="URL",
+                   help="also poll a dampr-tpu-serve daemon (base URL, "
+                        "e.g. http://127.0.0.1:9400) and render its job "
+                        "table (tenant, state, queue wait, reuse hits) "
+                        "below the rank rows")
     p.add_argument("--once", action="store_true",
                    help="one snapshot, no terminal control codes")
     p.add_argument("--json", action="store_true",
@@ -293,21 +357,32 @@ def main(argv=None):
             p.error("--ports wants a comma-separated integer list")
     ports = resolve_ports(base_port=args.port, ranks=args.ranks,
                           ports=ports, timeout=timeout)
-    if not ports:
-        print("no metrics ports to poll: pass --port/--ports or set "
-              "DAMPR_TPU_METRICS_PORT", file=sys.stderr)
+    if not ports and not args.jobs:
+        print("no metrics ports to poll: pass --port/--ports, set "
+              "DAMPR_TPU_METRICS_PORT, or pass --jobs URL",
+              file=sys.stderr)
         return 1
 
     if args.once:
-        rows = snapshot(ports, timeout=timeout)
+        rows = snapshot(ports, timeout=timeout) if ports else []
+        jobs_doc = scrape_jobs(args.jobs, timeout=timeout) \
+            if args.jobs else None
         if args.json:
-            print(json.dumps({"ports": ports, "ranks": rows},
-                             indent=2, sort_keys=True))
+            doc = {"ports": ports, "ranks": rows}
+            if args.jobs:
+                doc["jobs"] = jobs_doc
+            print(json.dumps(doc, indent=2, sort_keys=True))
         else:
-            print(render(rows))
-        return 0 if any(r["alive"] for r in rows) else 1
+            if ports:
+                print(render(rows))
+            if args.jobs:
+                if ports:
+                    print()
+                print(render_jobs(jobs_doc))
+        alive = any(r["alive"] for r in rows) or jobs_doc is not None
+        return 0 if alive else 1
 
-    return _live_loop(ports, refresh_ms, timeout)
+    return _live_loop(ports, refresh_ms, timeout, jobs_url=args.jobs)
 
 
 if __name__ == "__main__":

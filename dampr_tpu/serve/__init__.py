@@ -1,0 +1,40 @@
+"""``dampr_tpu.serve`` — the disaggregated multi-tenant pipeline service.
+
+One long-running daemon (``dampr-tpu-serve``) accepts composed plan IR
+from many concurrent clients over HTTP, the tf.data-service argument
+(arXiv 2210.14826) applied to this engine: input processing is a
+*service*, not a per-caller batch process, so compiled/cached stage
+materializations amortize across submissions instead of dying with each
+process.
+
+The package splits along the daemon's own seams:
+
+- :mod:`.wire` — the validated, fingerprinted plan wire-form: a
+  stdlib-only by-value serializer for composed graphs (lambdas ship by
+  code), the submission fingerprint (``resume.stage_fingerprints``
+  chained to the requested output), and the input-byte cost estimate
+  the scheduler charges against tenant budgets.
+- :mod:`.scheduler` — per-tenant job queues with deficit-round-robin
+  fair sharing over byte budgets, reservation accounting, and in-flight
+  fingerprint dedupe (identical submissions coalesce onto one run).
+- :mod:`.worker` — the per-job subprocess entry point: one job = one
+  process = one run scope, so the PR 10 fault layer (classified
+  retries, quarantine, SIGTERM crashdumps) isolates tenants from each
+  other and from the daemon.
+- :mod:`.daemon` — the HTTP service itself: ``/submit``, ``/jobs``,
+  ``/result``, ``/cancel``, ``/metrics``, ``/healthz``, ``/drain``,
+  plus the dispatch loop, per-job timeouts, graceful SIGTERM drain,
+  and the coded event stream (``serve-*`` in ``obs.log.EVENT_CODES``).
+- :mod:`.client` — the stdlib client (``ServeClient`` / ``RemoteJob``)
+  behind the ``PBase.submit(url)`` DSL hook.
+
+See ``docs/serve.md`` for the protocol, the fairness/admission
+contract, and the isolation guarantees.
+"""
+
+from .client import RemoteJob, ServeClient, SubmitError
+from .daemon import ServeDaemon
+from .wire import WireError
+
+__all__ = ["RemoteJob", "ServeClient", "ServeDaemon", "SubmitError",
+           "WireError"]

@@ -1,0 +1,111 @@
+"""Per-job worker: ``python -m dampr_tpu.serve.worker <job_dir>``.
+
+One job = one process = one run scope.  The daemon gets real isolation
+for free from this shape: a poison record, a per-job timeout, a client
+cancellation, or an operator SIGTERM all land on *this* process — the
+PR 10 fault layer classifies and retries inside it (``resume="auto"``),
+the runner's SIGTERM handler walks the crashdump path (the job dies
+with a schema-valid ``crashdump.json``, exit 143), and the daemon
+merely reaps an exit code.  Nothing a tenant ships can take the daemon
+down.
+
+Contract with the daemon (all paths inside ``job_dir``):
+
+- ``job.json`` (read): run name, resume mode, daemon-assigned options;
+- ``payload.bin`` (read): the :mod:`.wire` envelope;
+- ``result.pkl`` (written on success, atomically): pickled list of the
+  output's ``(key, value)`` records — the bytes the daemon streams
+  back verbatim to every client of this run (byte-exactness is
+  end-to-end: the daemon never re-serializes results);
+- ``result.json`` (written on success): small JSON meta — wall
+  seconds, record count, the run's reuse section, artifact paths;
+- ``error.json`` (written on failure, best-effort): classified error.
+
+Environment is the daemon's doing (see ``daemon._spawn``): the shared
+scratch root and reuse cache directory, ``DAMPR_TPU_SERVE_ACTIVE=1``
+(which resolves ``settings.reuse`` "auto" ON — the whole point of
+serving: shared-prefix materializations amortize across tenants), and
+a per-job trace dir so crash artifacts land under the job's directory.
+"""
+
+import json
+import os
+import pickle
+import sys
+import time
+
+
+def _write_json(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, default=str)
+    os.replace(tmp, path)
+
+
+def run_job(job_dir):
+    """Execute the job under ``job_dir``; returns the process exit code."""
+    with open(os.path.join(job_dir, "job.json")) as f:
+        spec = json.load(f)
+    with open(os.path.join(job_dir, "payload.bin"), "rb") as f:
+        payload = f.read()
+
+    from . import wire
+    from .. import dampr as _dampr
+
+    started = time.time()
+    try:
+        graph, source = wire.decode(payload)
+        handle = _dampr.PBase(source, _dampr.Dampr(graph))
+        kwargs = {}
+        resume = spec.get("resume", "auto")
+        if resume:
+            kwargs["resume"] = resume
+        em = handle.run(name=spec["run_name"], **kwargs)
+        records = list(em.dataset.read())
+        tmp = os.path.join(job_dir, "result.pkl.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(records, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, os.path.join(job_dir, "result.pkl"))
+        summary = em.stats() or {}
+        _write_json(os.path.join(job_dir, "result.json"), {
+            "wall_seconds": round(time.time() - started, 6),
+            "records": len(records),
+            "reuse": summary.get("reuse"),
+            "trace_file": summary.get("trace_file"),
+            "stats_file": summary.get("stats_file"),
+            "run_name": spec["run_name"],
+        })
+        return 0
+    except BaseException as e:
+        from .. import faults as _faults
+
+        try:
+            import traceback
+
+            _write_json(os.path.join(job_dir, "error.json"), {
+                "type": type(e).__name__,
+                "message": str(e)[:2000],
+                "kind": _faults.classify(e),
+                "wall_seconds": round(time.time() - started, 6),
+                "traceback": traceback.format_exc()[-4000:],
+            })
+        except Exception:
+            pass
+        if isinstance(e, SystemExit):
+            raise  # the runner's SIGTERM path already chose the code
+        if isinstance(e, KeyboardInterrupt):
+            return 130
+        return 1
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m dampr_tpu.serve.worker <job_dir>",
+              file=sys.stderr)
+        return 2
+    return run_job(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

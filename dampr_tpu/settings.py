@@ -421,16 +421,23 @@ autotune_trials = int(os.environ.get("DAMPR_TPU_AUTOTUNE_TRIALS", "4"))
 #: under ``reuse_dir`` so identical pipeline prefixes — across runs,
 #: run NAMES, and processes — mount cached partition frames instead of
 #: recomputing, and append-only input growth re-runs only the new
-#: chunks.  "auto" (default) currently resolves OFF — it is reserved
-#: for the serve daemon (ROADMAP item 1), which will resolve it on for
-#: deduped submissions; "0"/"off" pins the cache fully out of the path
-#: (plans, fingerprints, and results are byte-identical either way —
-#: the reuse-off CI leg asserts exactly that).
+#: chunks.  "auto" (default) resolves OFF in ordinary processes and ON
+#: inside serve-daemon workers (``serve_active``): served submissions
+#: share materializations across tenants by default, exactly the
+#: amortization the service exists for.  "0"/"off" pins the cache
+#: fully out of the path — including inside the daemon, so the
+#: reuse-off CI leg stays byte-identical end to end (plans,
+#: fingerprints, and results are byte-identical either way).
 reuse = os.environ.get("DAMPR_TPU_REUSE", "auto")
 
 
 def reuse_enabled():
-    return str(reuse).lower() in ("on", "1", "true", "yes")
+    v = str(reuse).lower()
+    if v in ("on", "1", "true", "yes"):
+        return True
+    if v in ("off", "0", "false", "no"):
+        return False
+    return bool(serve_active)  # "auto": ON inside serve-daemon workers
 
 
 #: Byte budget for the shared reuse cache directory.  Publishing past
@@ -931,6 +938,79 @@ sentry_mad_threshold = float(os.environ.get("DAMPR_TPU_SENTRY_MAD", "3.5"))
 #: Live fleet dashboard (dampr_tpu.obs.top / ``dampr-tpu-top``): refresh
 #: cadence in milliseconds between endpoint polls.
 top_refresh_ms = int(os.environ.get("DAMPR_TPU_TOP_REFRESH_MS", "1000"))
+
+# ---------------------------------------------------------------------------
+# Pipeline service daemon (dampr_tpu.serve / ``dampr-tpu-serve``)
+# ---------------------------------------------------------------------------
+
+#: Daemon HTTP port (``dampr-tpu-serve``).  A busy port probes upward
+#: (same degradation contract as the metrics endpoint); 0 asks the OS
+#: for an ephemeral port (tests).
+serve_port = int(os.environ.get("DAMPR_TPU_SERVE_PORT", "9400"))
+
+#: Daemon bind address.  Loopback by default: the wire is pickle, so
+#: the protocol is trusted-client (docs/serve.md) — exposing it wider
+#: is an explicit operator decision.
+serve_host = os.environ.get("DAMPR_TPU_SERVE_HOST", "127.0.0.1")
+
+#: Concurrent job slots: how many per-job worker subprocesses the
+#: daemon runs at once.  Queued jobs dispatch deficit-round-robin
+#: across tenants as slots free.
+serve_workers = int(os.environ.get("DAMPR_TPU_SERVE_WORKERS", "2"))
+
+#: Per-tenant admission byte budget: the sum of estimated input bytes a
+#: tenant's queued + running jobs may reserve.  A submission past it is
+#: rejected with the coded ``serve-reject`` event (reason ``budget``)
+#: instead of queueing unboundedly; a finished or cancelled job
+#: releases its reservation immediately.
+serve_tenant_budget = int(os.environ.get("DAMPR_TPU_SERVE_BUDGET",
+                                         str(2 * 1024 ** 3)))
+
+#: Deficit-round-robin quantum (bytes): the byte allowance each tenant's
+#: deficit counter earns per scheduling round.  Smaller = finer-grained
+#: fairness between tenants with very different job sizes.
+serve_quantum = int(os.environ.get("DAMPR_TPU_SERVE_QUANTUM",
+                                   str(64 * 1024 ** 2)))
+
+#: Per-tenant queue depth: submissions past this many queued jobs are
+#: rejected (reason ``queue-full``) — backpressure at the door, not an
+#: unbounded queue.
+serve_queue_depth = int(os.environ.get("DAMPR_TPU_SERVE_QUEUE_DEPTH", "16"))
+
+#: Per-job wall-clock timeout (milliseconds): past it the daemon
+#: SIGTERMs the job's worker (which walks the crashdump path, so the
+#: tenant still gets a schema-valid artifact), then SIGKILLs a
+#: straggler.  0 = no timeout.  A client may pass a tighter per-job
+#: ``timeout_s`` at submit.
+serve_job_timeout_ms = int(os.environ.get("DAMPR_TPU_SERVE_JOB_TIMEOUT_MS",
+                                          "600000"))
+
+#: Graceful-drain deadline (milliseconds): on SIGTERM (or POST /drain)
+#: the daemon stops admitting, finishes everything already admitted,
+#: and terminates whatever is still running when this deadline fires.
+serve_drain_ms = int(os.environ.get("DAMPR_TPU_SERVE_DRAIN_MS", "30000"))
+
+#: Whether serve workers run traced (DAMPR_TPU_TRACE=1 in the job
+#: environment).  On (default) so a killed or crashed tenant job always
+#: leaves a schema-valid ``crashdump.json`` under its job directory —
+#: the isolation contract's evidence trail.  Turn off only to shave the
+#: trace plane's overhead from high-rate serving.
+serve_trace = os.environ.get("DAMPR_TPU_SERVE_TRACE", "1").lower() not in (
+    "0", "false", "no", "off", "")
+
+#: How many terminal job records (and their job directories) the daemon
+#: retains; older ones are evicted with a coded ``serve-evict`` event.
+serve_jobs_keep = int(os.environ.get("DAMPR_TPU_SERVE_JOBS_KEEP", "256"))
+
+#: Daemon state directory (job payloads, results, event log).  Empty
+#: (default) resolves to ``<scratch_root>/serve`` at daemon start.
+serve_dir = os.environ.get("DAMPR_TPU_SERVE_DIR", "")
+
+#: Set (to 1) by the daemon in every worker's environment — this is how
+#: ``reuse_enabled()`` resolves the "auto" reuse mode ON inside served
+#: jobs and OFF everywhere else.  Not an operator knob.
+serve_active = os.environ.get("DAMPR_TPU_SERVE_ACTIVE", "0").lower() not in (
+    "0", "false", "no", "off", "")
 
 #: Partition-size threshold (bytes) above which a single-input reduce streams
 #: a k-way merge over hash-sorted runs instead of materializing the partition

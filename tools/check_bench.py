@@ -93,17 +93,33 @@ def headline(rec, key="value"):
     return None
 
 
-def compare(fresh, baselines, tolerance, key="value"):
+def record_direction(rec, default="higher"):
+    """A record's gating direction: ``"higher"`` (throughput-like,
+    the historical default) or ``"lower"`` (latency-like: p99, wall
+    seconds).  Benches stamp ``direction`` into the record so their
+    baselines gate the right way without per-CI-job configuration."""
+    d = str(rec.get("direction") or default).lower()
+    return "lower" if d == "lower" else "higher"
+
+
+def compare(fresh, baselines, tolerance, key="value", direction=None):
     """Compare one fresh record against (path, record) baselines.
 
-    Returns a report dict: ``ok`` (bool), ``fresh``, ``best`` (None when
-    no usable baseline), ``best_path``, ``drop`` (fractional, negative =
-    improvement), ``skipped`` (unusable baseline paths), ``notes``.
+    ``direction`` "higher" (default) gates a drop below the best (=max)
+    baseline; "lower" gates a rise above the best (=min) baseline —
+    latency metrics regress UP.  None reads the fresh record's own
+    ``direction`` field.  Returns a report dict: ``ok`` (bool),
+    ``fresh``, ``best`` (None when no usable baseline), ``best_path``,
+    ``drop`` (fractional regression in the metric's bad direction,
+    negative = improvement), ``skipped`` (unusable baseline paths),
+    ``notes``.
     """
     fresh_v = headline(fresh, key)
     if fresh_v is None:
         raise ValueError(
             "fresh bench record has no numeric {!r} field".format(key))
+    if direction is None:
+        direction = record_direction(fresh)
     metric = fresh.get("metric")
     best = None
     best_path = None
@@ -117,26 +133,33 @@ def compare(fresh, baselines, tolerance, key="value"):
         if metric and bmetric and bmetric != metric:
             skipped.append(path)
             continue
-        if best is None or v > best:
+        if best is None or ((v < best) if direction == "lower"
+                            else (v > best)):
             best, best_path = v, path
     report = {
-        "metric": metric, "fresh": fresh_v, "best": best,
-        "best_path": best_path, "skipped": skipped, "tolerance": tolerance,
-        "drop": None, "ok": True, "notes": [],
+        "metric": metric, "direction": direction, "fresh": fresh_v,
+        "best": best, "best_path": best_path, "skipped": skipped,
+        "tolerance": tolerance, "drop": None, "ok": True, "notes": [],
     }
     if best is None:
         report["notes"].append(
             "no usable baseline (no numeric {!r} with a matching metric): "
             "gate passes vacuously".format(key))
         return report
-    drop = (best - fresh_v) / best if best > 0 else 0.0
+    if best > 0:
+        if direction == "lower":
+            drop = (fresh_v - best) / best   # fractional rise = regression
+        else:
+            drop = (best - fresh_v) / best   # fractional drop = regression
+    else:
+        drop = 0.0
     report["drop"] = drop
     report["ok"] = drop <= tolerance
     return report
 
 
 def trend(fresh, baselines, key="value", min_rounds=3,
-          include_fresh=True):
+          include_fresh=True, direction=None):
     """Trajectory check over the baselines IN THE ORDER GIVEN (pass them
     oldest-first; the caller's ordering is the round ordering).
 
@@ -151,6 +174,8 @@ def trend(fresh, baselines, key="value", min_rounds=3,
     when that suffix spans >= ``min_rounds`` points), ``note``.
     """
     fresh_v = headline(fresh, key)
+    if direction is None:
+        direction = record_direction(fresh)
     metric = fresh.get("metric")
     points = []
     for path, rec in baselines:
@@ -171,7 +196,9 @@ def trend(fresh, baselines, key="value", min_rounds=3,
         return report
     decl = 1
     for i in range(len(points) - 1, 0, -1):
-        if points[i][1] < points[i - 1][1]:
+        worse = (points[i][1] > points[i - 1][1] if direction == "lower"
+                 else points[i][1] < points[i - 1][1])
+        if worse:
             decl += 1
         else:
             break
@@ -206,6 +233,13 @@ def main(argv=None):
                          "(default 0.25)")
     ap.add_argument("--metric-key", default="value",
                     help="record key holding the gated number")
+    ap.add_argument("--direction", choices=("auto", "higher", "lower"),
+                    default="auto",
+                    help="gating direction: 'higher' = throughput-like "
+                         "(drop below best baseline regresses, the "
+                         "default), 'lower' = latency-like (rise above "
+                         "best regresses — p99, wall seconds); 'auto' "
+                         "reads the fresh record's own 'direction' field")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on regression (default: warn only)")
     ap.add_argument("--trend", action="store_true",
@@ -221,18 +255,22 @@ def main(argv=None):
                          "don't chain")
     args = ap.parse_args(argv)
 
+    direction = None if args.direction == "auto" else args.direction
     try:
         fresh = load_record(args.fresh)
         baselines = [(p, load_record(p)) for p in args.baseline]
         trend_pool = [(p, load_record(p)) for p in args.trend_baseline]
         report = compare(fresh, baselines, args.tolerance,
-                         key=args.metric_key)
+                         key=args.metric_key, direction=direction)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print("check_bench: ERROR: {}".format(e), file=sys.stderr)
         return 2
 
+    direction = report["direction"]
     metric = report["metric"] or args.metric_key
-    print("check_bench: {} fresh={:.4g}".format(metric, report["fresh"]))
+    print("check_bench: {} fresh={:.4g}{}".format(
+        metric, report["fresh"],
+        " (lower is better)" if direction == "lower" else ""))
     # Device-execution shape (informational, never gated): where the
     # plan placed stages and what the host moved to feed them.
     if fresh.get("device_stages") is not None:
@@ -256,7 +294,10 @@ def main(argv=None):
         pred = fresh.get("model_predicted_value")
         if (isinstance(pred, (int, float)) and not isinstance(pred, bool)
                 and pred > 0):
-            residual = (pred - report["fresh"]) / pred
+            if direction == "lower":
+                residual = (report["fresh"] - pred) / pred
+            else:
+                residual = (pred - report["fresh"]) / pred
             if residual > args.tolerance:
                 print("check_bench: MODEL WARN: measured {:.4g} fell "
                       "{:.1%} below the cost model's own prediction "
@@ -274,16 +315,19 @@ def main(argv=None):
         # (different measurement scales would fake a decline).
         if trend_pool:
             t = trend(fresh, trend_pool, key=args.metric_key,
-                      include_fresh=False)
+                      include_fresh=False, direction=direction)
         else:
-            t = trend(fresh, baselines, key=args.metric_key)
+            t = trend(fresh, baselines, key=args.metric_key,
+                      direction=direction)
         if t["note"]:
             print("check_bench: trend: {}".format(t["note"]))
         elif t["regressing"]:
             tail = t["points"][-t["declining"]:]
-            print("check_bench: TREND WARN: {} declined across {} "
+            print("check_bench: TREND WARN: {} {} across {} "
                   "consecutive round(s): {}".format(
-                      metric, t["declining"],
+                      metric,
+                      "rose" if direction == "lower" else "declined",
+                      t["declining"],
                       " -> ".join("{}={:.4g}".format(p, v)
                                   for p, v in tail)))
         else:
@@ -302,10 +346,11 @@ def main(argv=None):
     if report["ok"]:
         print("check_bench: PASS")
         return 0
-    msg = ("{} regressed {:.1%} below the best baseline "
+    msg = ("{} regressed {:.1%} {} the best baseline "
            "({:.4g} -> {:.4g}, tolerance {:.0%})".format(
-               metric, report["drop"], report["best"], report["fresh"],
-               report["tolerance"]))
+               metric, report["drop"],
+               "above" if direction == "lower" else "below",
+               report["best"], report["fresh"], report["tolerance"]))
     if args.strict:
         print("check_bench: FAIL")
         print("check_bench: " + msg, file=sys.stderr)
